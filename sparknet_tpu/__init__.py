@@ -1,0 +1,31 @@
+"""sparknet_tpu — a TPU-native distributed deep-learning framework.
+
+A brand-new framework with the capabilities of SparkNet (distributed neural
+networks with per-worker native engines + synchronous tau-step parameter
+averaging), re-designed TPU-first:
+
+- The per-worker Caffe/CUDA engine (reference: ``caffe/src/caffe``) becomes a
+  JAX/XLA net compiler: ``NetParameter`` configs compile to pure, jitted
+  ``forward``/``loss`` functions (``sparknet_tpu.net.JaxNet``).
+- The Spark broadcast/reduce parameter-averaging plane and the in-node P2PSync
+  GPU tree (reference: ``src/main/scala/apps/*.scala``, ``caffe/src/caffe/
+  parallel.cpp``) both lower to XLA collectives (``psum``) over an ICI/DCN
+  device mesh (``sparknet_tpu.parallel``).
+- The JVM->native callback data layer (reference: ``caffe/src/caffe/layers/
+  java_data_layer.cpp``) inverts into async host prefetch pipelines feeding
+  device buffers (``sparknet_tpu.data``).
+
+See SURVEY.md at the repo root for the full reference analysis.
+"""
+
+__version__ = "0.1.0"
+
+from sparknet_tpu.config import (  # noqa: F401
+    NetParameter,
+    SolverParameter,
+    LayerParameter,
+    load_net_prototxt,
+    load_solver_prototxt,
+    parse_net_prototxt,
+    parse_solver_prototxt,
+)

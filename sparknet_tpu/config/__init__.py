@@ -1,0 +1,107 @@
+"""Config system: proto2-text-compatible net/solver definitions.
+
+Replaces the reference's protobuf config plane (``caffe.proto`` schema +
+``ProtoLoader.scala`` + ``ccaffe.cpp:275-304`` parsing services) with typed
+dataclasses and a native prototxt parser.
+"""
+
+from sparknet_tpu.config.schema import *  # noqa: F401,F403
+from sparknet_tpu.config import schema as _schema
+from sparknet_tpu.config.prototext import parse, parse_file, dumps, ParseError
+from sparknet_tpu.config.schema import (
+    NetParameter,
+    SolverParameter,
+    LayerParameter,
+    NetState,
+)
+
+
+def parse_net_prototxt(text: str, permissive: bool = False) -> NetParameter:
+    """Parse net prototxt text (reference: ``ProtoLoader.loadNetPrototxt``,
+    src/main/scala/libs/ProtoLoader.scala:20-29)."""
+    return parse(text, NetParameter, permissive=permissive)
+
+
+def parse_solver_prototxt(text: str, permissive: bool = False) -> SolverParameter:
+    return parse(text, SolverParameter, permissive=permissive)
+
+
+def load_net_prototxt(path: str, permissive: bool = False) -> NetParameter:
+    return parse_file(path, NetParameter, permissive=permissive)
+
+
+def load_solver_prototxt(path: str, permissive: bool = False) -> SolverParameter:
+    return parse_file(path, SolverParameter, permissive=permissive)
+
+
+def load_solver_prototxt_with_net(
+    solver_path: str, net_path: str, keep_snapshot: bool = False
+) -> SolverParameter:
+    """Load a solver and embed the net definition inline, clearing snapshot
+    config unless asked otherwise (reference: ``ProtoLoader.
+    loadSolverPrototxtWithNet``, src/main/scala/libs/ProtoLoader.scala:31-43 —
+    SparkNet drivers own checkpointing, so file-based solver snapshots are
+    disabled by default)."""
+    solver = load_solver_prototxt(solver_path)
+    solver.net = None
+    solver.train_net = None
+    solver.test_net = []
+    solver.net_param = load_net_prototxt(net_path)
+    if not keep_snapshot:
+        solver.snapshot = 0
+        solver.snapshot_prefix = ""
+    return solver
+
+
+def replace_data_layers(
+    net: NetParameter,
+    train_batch_shapes,
+    test_batch_shapes=None,
+) -> NetParameter:
+    """Swap leading data layers for host-fed ``HostData`` layers (reference:
+    ``ProtoLoader.replaceDataLayers``, src/main/scala/libs/ProtoLoader.scala:
+    50-57, which swaps in JavaData ``RDDLayer``s).
+
+    ``train_batch_shapes``/``test_batch_shapes`` are lists of shapes, one per
+    top blob of the data layer (typically ``[(N,C,H,W), (N,)]`` for
+    data+label).
+    """
+    from sparknet_tpu.config.schema import BlobShape, JavaDataParameter, NetStateRule
+
+    net = net.copy()
+    data_types = {
+        "Data",
+        "ImageData",
+        "HDF5Data",
+        "MemoryData",
+        "DummyData",
+        "WindowData",
+        "JavaData",
+        "HostData",
+        "Input",
+    }
+    kept = [l for l in net.layer if l.type not in data_types]
+    tops = None
+    for l in net.layer:
+        if l.type in data_types:
+            tops = list(l.top)
+            break
+    if tops is None:
+        tops = ["data", "label"]
+
+    def mk(phase, shapes):
+        return LayerParameter(
+            name=f"{'train' if phase == 'TRAIN' else 'test'}_data",
+            type="HostData",
+            top=list(tops[: len(shapes)]),
+            include=[NetStateRule(phase=phase)],
+            java_data_param=JavaDataParameter(
+                shape=[BlobShape(dim=list(map(int, s))) for s in shapes]
+            ),
+        )
+
+    new_layers = [mk("TRAIN", train_batch_shapes)]
+    if test_batch_shapes is not None:
+        new_layers.append(mk("TEST", test_batch_shapes))
+    net.layer = new_layers + kept
+    return net

@@ -1,0 +1,173 @@
+"""Config-system tests: parse/print round trips and schema binding.
+
+Mirrors the role of the reference's prototxt loading tests
+(``src/test/scala/libs/LayerSpec.scala`` round-trips a DSL net and a prototxt
+through the native parser).
+"""
+
+import pytest
+
+from sparknet_tpu import config
+from sparknet_tpu.config import prototext, schema
+
+CIFAR_SOLVER = """
+# comment line
+net: "models/cifar10_full_train_test.prototxt"
+test_iter: 100
+test_interval: 1000
+base_lr: 0.001
+momentum: 0.9
+weight_decay: 0.004
+lr_policy: "fixed"
+display: 200
+max_iter: 60000
+snapshot: 10000
+snapshot_format: HDF5
+snapshot_prefix: "cifar10_full"
+solver_mode: GPU
+"""
+
+NET = """
+name: "tiny"
+layer {
+  name: "data"
+  type: "DummyData"
+  top: "data"
+  top: "label"
+  dummy_data_param {
+    shape { dim: 4 dim: 3 dim: 8 dim: 8 }
+    shape { dim: 4 }
+  }
+}
+layer {
+  name: "conv1"
+  type: "Convolution"
+  bottom: "data"
+  top: "conv1"
+  param { lr_mult: 1 }
+  param { lr_mult: 2 }
+  convolution_param {
+    num_output: 32
+    pad: 2
+    kernel_size: 5
+    stride: 1
+    weight_filler { type: "gaussian" std: 0.0001 }
+    bias_filler { type: "constant" }
+  }
+}
+layer {
+  name: "pool1"
+  type: "Pooling"
+  bottom: "conv1"
+  top: "pool1"
+  pooling_param { pool: MAX kernel_size: 3 stride: 2 }
+}
+layer {
+  name: "loss"
+  type: "SoftmaxWithLoss"
+  bottom: "pool1"
+  bottom: "label"
+  top: "loss"
+  include { phase: TRAIN }
+}
+"""
+
+
+def test_parse_solver():
+    s = config.parse_solver_prototxt(CIFAR_SOLVER)
+    assert s.net == "models/cifar10_full_train_test.prototxt"
+    assert s.test_iter == [100]
+    assert s.test_interval == 1000
+    assert s.base_lr == pytest.approx(0.001)
+    assert s.momentum == pytest.approx(0.9)
+    assert s.weight_decay == pytest.approx(0.004)
+    assert s.lr_policy == "fixed"
+    assert s.max_iter == 60000
+    assert s.snapshot_format == "HDF5"
+    assert s.solver_mode == "GPU"
+    # defaults preserved
+    assert s.iter_size == 1
+    assert s.type == "SGD"
+
+
+def test_parse_net():
+    n = config.parse_net_prototxt(NET)
+    assert n.name == "tiny"
+    assert [l.name for l in n.layer] == ["data", "conv1", "pool1", "loss"]
+    conv = n.layer[1]
+    assert conv.convolution_param.num_output == 32
+    assert conv.convolution_param.pad == [2]
+    assert conv.convolution_param.kernel_size == [5]
+    assert conv.convolution_param.weight_filler.type == "gaussian"
+    assert conv.convolution_param.weight_filler.std == pytest.approx(1e-4)
+    assert [p.lr_mult for p in conv.param] == [1.0, 2.0]
+    pool = n.layer[2]
+    assert pool.pooling_param.pool == "MAX"
+    assert pool.pooling_param.kernel_size == 3
+    assert pool.pooling_param.stride == 2
+    loss = n.layer[3]
+    assert loss.bottom == ["pool1", "label"]
+    assert loss.include[0].phase == "TRAIN"
+    shapes = n.layer[0].dummy_data_param.shape
+    assert shapes[0].dim == [4, 3, 8, 8]
+    assert shapes[1].dim == [4]
+
+
+def test_round_trip():
+    n = config.parse_net_prototxt(NET)
+    text = prototext.dumps(n)
+    n2 = config.parse_net_prototxt(text)
+    assert n2 == n
+    s = config.parse_solver_prototxt(CIFAR_SOLVER)
+    s2 = config.parse_solver_prototxt(prototext.dumps(s))
+    assert s2 == s
+
+
+def test_unknown_field_raises():
+    with pytest.raises(prototext.ParseError):
+        config.parse_net_prototxt("nonexistent_field: 3")
+    # permissive mode ignores
+    n = config.parse_net_prototxt('nonexistent_field: 3 name: "x"', permissive=True)
+    assert n.name == "x"
+
+
+def test_angle_bracket_and_inline_syntax():
+    n = config.parse_net_prototxt(
+        'layer < name: "a" type: "ReLU" relu_param < negative_slope: 0.1 > >'
+    )
+    assert n.layer[0].relu_param.negative_slope == pytest.approx(0.1)
+    # colon before message block is legal
+    n = config.parse_net_prototxt('layer: { name: "b" type: "TanH" }')
+    assert n.layer[0].name == "b"
+
+
+def test_legacy_layers_field_merges():
+    n = config.parse_net_prototxt('layers { name: "old" type: "ReLU" }')
+    assert n.layer[0].name == "old"
+    assert n.layers == []
+
+
+def test_string_escapes_and_bool():
+    n = config.parse_net_prototxt('name: "a\\"b" force_backward: true')
+    assert n.name == 'a"b'
+    assert n.force_backward is True
+
+
+def test_legacy_solver_type_enum():
+    s = config.parse_solver_prototxt("solver_type: ADAM")
+    assert schema.solver_method(s) == "ADAM"
+    s2 = config.parse_solver_prototxt('type: "Nesterov"')
+    assert schema.solver_method(s2) == "NESTEROV"
+
+
+def test_replace_data_layers():
+    n = config.parse_net_prototxt(NET)
+    n2 = config.replace_data_layers(n, [(8, 3, 8, 8), (8,)], [(4, 3, 8, 8), (4,)])
+    types = [l.type for l in n2.layer]
+    assert types[:2] == ["HostData", "HostData"]
+    assert "DummyData" not in types
+    assert n2.layer[0].top == ["data", "label"]
+    assert n2.layer[0].java_data_param.shape[0].dim == [8, 3, 8, 8]
+    assert n2.layer[1].include[0].phase == "TEST"
+    # original untouched
+    assert n.layer[0].type == "DummyData"
