@@ -1,0 +1,74 @@
+"""Graph passes over NetParameter.
+
+The reference runs FilterNet (phase/stage/level rules, ``net.cpp:287-366``)
+then InsertSplits (``insert_splits.cpp``) before building.  Here only the
+filter pass survives: split insertion existed to give hand-written backward
+passes explicit gradient-accumulation points, and ``jax.grad`` accumulates
+fan-out gradients natively, so that pass is a no-op by construction.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from sparknet_tpu.config.schema import (
+    LayerParameter,
+    NetParameter,
+    NetState,
+    NetStateRule,
+)
+
+__all__ = ["filter_net", "state_meets_rule", "toposort_check"]
+
+
+def state_meets_rule(state: NetState, rule: NetStateRule) -> bool:
+    """NetState vs NetStateRule matching (reference: ``net.cpp
+    StateMeetsRule``)."""
+    if rule.phase is not None and rule.phase != state.phase:
+        return False
+    if rule.min_level is not None and state.level < rule.min_level:
+        return False
+    if rule.max_level is not None and state.level > rule.max_level:
+        return False
+    for s in rule.stage:
+        if s not in state.stage:
+            return False
+    for s in rule.not_stage:
+        if s in state.stage:
+            return False
+    return True
+
+
+def _layer_included(layer: LayerParameter, state: NetState) -> bool:
+    # legacy per-layer phase field acts like an include rule
+    if layer.phase is not None and not layer.include and layer.phase != state.phase:
+        return False
+    if layer.include:
+        return any(state_meets_rule(state, r) for r in layer.include)
+    return not any(state_meets_rule(state, r) for r in layer.exclude)
+
+
+def filter_net(net: NetParameter, state: NetState) -> NetParameter:
+    """Return a copy of ``net`` keeping only layers whose rules admit
+    ``state``."""
+    out = net.copy()
+    out.state = NetState(
+        phase=state.phase, level=state.level, stage=list(state.stage)
+    )
+    out.layer = [l for l in net.layer if _layer_included(l, state)]
+    return out
+
+
+def toposort_check(net: NetParameter, external_tops: List[str] = ()) -> None:
+    """Validate the reference's execution contract: layers run in listed
+    order and every bottom must already be produced (``net.cpp
+    AppendBottom`` errors otherwise).  In-place tops rebind the same name."""
+    available = set(external_tops) | set(net.input)
+    for layer in net.layer:
+        for b in layer.bottom:
+            if b not in available:
+                raise ValueError(
+                    f"layer {layer.name!r}: unknown bottom blob {b!r} "
+                    f"(blob order follows listed layer order)"
+                )
+        available.update(layer.top)

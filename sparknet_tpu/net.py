@@ -1,0 +1,289 @@
+"""JaxNet — the net compiler: NetParameter -> pure jitted functions.
+
+This is the TPU-native replacement for the whole reference engine stack
+``Net<Dtype>`` + ``Solver``'s forward path (``caffe/src/caffe/net.cpp``) and
+for the Scala-side ``CaffeNet`` facade (``src/main/scala/libs/Net.scala``):
+
+- ``Net::Init`` (DAG build, phase filter, param sharing by name at
+  ``net.cpp:470``)  ->  ``JaxNet.__init__`` (static shape walk + blob init)
+- ``Net::ForwardFromTo`` layer loop  ->  ``JaxNet.apply`` — a pure function
+  of (params, stats, batch, rng) traced once under ``jit``; XLA fuses the
+  layer chain, so there is no per-layer dispatch at run time
+- data/diff twin blobs + ``Net::Update``  ->  gradients are values from
+  ``jax.grad``; no mutable state anywhere
+- ``getData``/``getWeights``/``setWeights`` float-copy loops
+  (``Net.scala:131-191``)  ->  zero-copy pytrees of device arrays
+
+Params layout parity: ``params[layer_name] == [weight, bias, ...]`` ordered
+exactly like the reference layer's ``blobs_`` vector, with shared params
+stored once under the owning layer (ParamSpec.name sharing).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sparknet_tpu.config.schema import NetParameter, NetState
+from sparknet_tpu.graph import filter_net, toposort_check
+from sparknet_tpu.ops import fillers  # noqa: F401  (registry population)
+from sparknet_tpu.ops import common, data_layers, losses, vision  # noqa: F401
+from sparknet_tpu.ops.base import BlobDef, Layer, create_layer
+
+Params = Dict[str, List[jax.Array]]
+Stats = Dict[str, List[jax.Array]]
+
+
+@dataclasses.dataclass
+class _BlobRef:
+    """Where one layer blob lives: (collection, owner layer, index)."""
+
+    collection: str  # "params" | "stats"
+    owner: str
+    index: int
+
+
+@dataclasses.dataclass
+class NetOutputs:
+    blobs: Dict[str, jax.Array]
+    loss: jax.Array
+    stats: Stats
+
+
+class JaxNet:
+    """A compiled net for one phase.
+
+    Parameters
+    ----------
+    net_param:
+        The (unfiltered) NetParameter; phase filtering happens here.
+    phase:
+        "TRAIN" or "TEST".
+    feed_shapes:
+        Extra {top_name: shape} for host-fed data layers that don't declare
+        shapes inline.
+    """
+
+    def __init__(
+        self,
+        net_param: NetParameter,
+        phase: str = "TRAIN",
+        feed_shapes: Optional[Dict[str, Sequence[int]]] = None,
+        stages: Sequence[str] = (),
+        level: int = 0,
+    ):
+        self.phase = phase.upper()
+        state = NetState(phase=self.phase, level=level, stage=list(stages))
+        self.net_param = filter_net(net_param, state)
+        self.name = self.net_param.name
+        feed_shapes = {k: tuple(map(int, v)) for k, v in (feed_shapes or {}).items()}
+
+        # net-level `input:` declarations are host-fed blobs too
+        for i, blob in enumerate(self.net_param.input):
+            if blob not in feed_shapes:
+                if i < len(self.net_param.input_shape):
+                    feed_shapes[blob] = tuple(
+                        int(d) for d in self.net_param.input_shape[i].dim
+                    )
+                elif len(self.net_param.input_dim) >= 4 * (i + 1):
+                    feed_shapes[blob] = tuple(
+                        self.net_param.input_dim[4 * i : 4 * i + 4]
+                    )
+        toposort_check(self.net_param, external_tops=list(feed_shapes))
+
+        self.layers: List[Layer] = []
+        self.blob_shapes: Dict[str, Tuple[int, ...]] = dict(feed_shapes)
+        self.feed_blobs: List[str] = list(feed_shapes)
+        self._blob_defs: Dict[str, List[BlobDef]] = {}
+        self._blob_refs: Dict[str, List[_BlobRef]] = {}
+        self._loss_weights: Dict[str, List[float]] = {}
+        param_owners: Dict[str, _BlobRef] = {}  # ParamSpec.name -> ref
+
+        counts: Dict[str, int] = {}
+        for lp in self.net_param.layer:
+            layer = create_layer(lp, self.phase)
+            if layer.name in counts:
+                raise ValueError(f"duplicate layer name {layer.name!r}")
+            counts[layer.name] = 1
+            bshapes = [self.blob_shapes[b] for b in lp.bottom]
+
+            if isinstance(layer, data_layers._HostFed):
+                declared = layer.declared_shapes()
+                tshapes = []
+                for i, top in enumerate(lp.top):
+                    if declared is not None and i < len(declared):
+                        shape = declared[i]
+                    elif top in feed_shapes:
+                        shape = feed_shapes[top]
+                    else:
+                        raise ValueError(
+                            f"data layer {layer.name!r}: no shape for top "
+                            f"{top!r}; pass feed_shapes"
+                        )
+                    tshapes.append(tuple(shape))
+                    self.feed_blobs.append(top)
+            else:
+                tshapes = layer.out_shapes(bshapes)
+
+            defs = layer.blob_defs(bshapes)
+            refs: List[_BlobRef] = []
+            pi = si = 0
+            for bi, d in enumerate(defs):
+                spec = lp.param[bi] if bi < len(lp.param) else None
+                shared_name = spec.name if spec and spec.name else None
+                if shared_name and shared_name in param_owners:
+                    owner_ref = param_owners[shared_name]
+                    owner_defs = self._blob_defs[owner_ref.owner]
+                    mode = (spec.share_mode or "STRICT").upper()
+                    if mode == "STRICT" and tuple(
+                        owner_defs[owner_ref.index].shape
+                    ) != tuple(d.shape):
+                        raise ValueError(
+                            f"shared param {shared_name!r}: shape mismatch "
+                            f"{owner_defs[owner_ref.index].shape} vs {d.shape}"
+                        )
+                    refs.append(owner_ref)
+                else:
+                    coll = "params" if d.learnable else "stats"
+                    ref = _BlobRef(coll, layer.name, pi if d.learnable else si)
+                    if d.learnable:
+                        pi += 1
+                    else:
+                        si += 1
+                    refs.append(ref)
+                    if shared_name:
+                        param_owners[shared_name] = ref
+
+            self._blob_defs[layer.name] = defs
+            self._blob_refs[layer.name] = refs
+            self._loss_weights[layer.name] = layer.loss_weights()
+            for top, shape in zip(lp.top, tshapes):
+                self.blob_shapes[top] = tuple(int(x) for x in shape)
+            self.layers.append(layer)
+
+        # dedupe feed blobs, preserve order
+        seen = set()
+        self.feed_blobs = [
+            b for b in self.feed_blobs if not (b in seen or seen.add(b))
+        ]
+
+    # ------------------------------------------------------------------
+    # Introspection (the `num_layers`/`layer_names`/blob enumeration side
+    # of the engine API, ccaffe.h:30-45)
+    # ------------------------------------------------------------------
+    @property
+    def layer_names(self) -> List[str]:
+        return [l.name for l in self.layers]
+
+    def param_multipliers(self) -> Tuple[Params, Params]:
+        """Per-blob (lr_mult, decay_mult) pytrees matching init() params
+        structure (reference: ParamSpec handling in ``net.cpp
+        AppendParam``)."""
+        lr: Dict[str, List[float]] = {}
+        decay: Dict[str, List[float]] = {}
+        for layer in self.layers:
+            for d, ref in zip(
+                self._blob_defs[layer.name], self._blob_refs[layer.name]
+            ):
+                if ref.collection == "params" and ref.owner == layer.name:
+                    lr.setdefault(layer.name, []).append(d.lr_mult)
+                    decay.setdefault(layer.name, []).append(d.decay_mult)
+        return lr, decay
+
+    # ------------------------------------------------------------------
+    # Init
+    # ------------------------------------------------------------------
+    def init(self, seed: int = 0) -> Tuple[Params, Stats]:
+        """Initialize all blobs with their fillers (Net::Init's filler pass)."""
+        key = jax.random.PRNGKey(seed)
+        params: Params = {}
+        stats: Stats = {}
+        for li, layer in enumerate(self.layers):
+            defs = self._blob_defs[layer.name]
+            refs = self._blob_refs[layer.name]
+            if not defs:
+                continue
+            lkey = jax.random.fold_in(key, li)
+            keys = jax.random.split(lkey, len(defs))
+            for d, ref, k in zip(defs, refs, keys):
+                if ref.owner != layer.name:
+                    continue  # shared: owner already initialized it
+                arr = fillers.fill(k, d.shape, d.filler)
+                if ref.collection == "params":
+                    params.setdefault(layer.name, []).append(arr)
+                else:
+                    stats.setdefault(layer.name, []).append(arr)
+        return params, stats
+
+    # ------------------------------------------------------------------
+    # Execution
+    # ------------------------------------------------------------------
+    def _gather_blobs(self, layer_name: str, params: Params, stats: Stats):
+        out = []
+        for ref in self._blob_refs[layer_name]:
+            coll = params if ref.collection == "params" else stats
+            out.append(coll[ref.owner][ref.index])
+        return out
+
+    def apply(
+        self,
+        params: Params,
+        stats: Stats,
+        batch: Dict[str, jax.Array],
+        rng: Optional[jax.Array] = None,
+        train: Optional[bool] = None,
+    ) -> NetOutputs:
+        """Run the net. Returns every named blob (the ``getData`` analog,
+        Net.scala:173-191), the weighted total loss, and updated stats."""
+        train = (self.phase == "TRAIN") if train is None else train
+        blobs: Dict[str, jax.Array] = {}
+        for b in self.feed_blobs:
+            if b not in batch:
+                raise ValueError(f"batch missing feed blob {b!r}")
+            blobs[b] = jnp.asarray(batch[b])
+        new_stats: Stats = {k: list(v) for k, v in stats.items()}
+        loss = jnp.asarray(0.0, jnp.float32)
+
+        for li, layer in enumerate(self.layers):
+            lp = layer.lp
+            if isinstance(layer, data_layers._HostFed):
+                tops = [blobs[t] for t in lp.top]
+            else:
+                lblobs = self._gather_blobs(layer.name, params, new_stats)
+                bottoms = [blobs[b] for b in lp.bottom]
+                lrng = jax.random.fold_in(rng, li) if rng is not None else None
+                tops, updated = layer.apply(lblobs, bottoms, lrng, train)
+                if updated is not None:
+                    refs = self._blob_refs[layer.name]
+                    for d, ref, arr in zip(
+                        self._blob_defs[layer.name], refs, updated
+                    ):
+                        if ref.collection == "stats":
+                            new_stats[ref.owner][ref.index] = arr
+            for w, top, name in zip(
+                self._loss_weights[layer.name], tops, lp.top
+            ):
+                if w:
+                    loss = loss + w * jnp.sum(top)
+            for name, top in zip(lp.top, tops):
+                blobs[name] = top
+        return NetOutputs(blobs=blobs, loss=loss, stats=new_stats)
+
+    def forward(
+        self,
+        params: Params,
+        stats: Stats,
+        batch: Dict[str, jax.Array],
+        rng: Optional[jax.Array] = None,
+    ) -> Dict[str, jax.Array]:
+        """Inference forward returning all blobs (FeaturizerApp's
+        forward+getData path, FeaturizerApp.scala:88-103)."""
+        return self.apply(params, stats, batch, rng=rng, train=False).blobs
+
+    def loss_fn(self, params, stats, batch, rng=None, train=True):
+        """(loss, (blobs, stats)) — the function handed to ``jax.grad``."""
+        out = self.apply(params, stats, batch, rng=rng, train=train)
+        return out.loss, (out.blobs, out.stats)

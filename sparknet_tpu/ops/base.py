@@ -1,0 +1,112 @@
+"""Layer protocol + registry for the net compiler.
+
+Plays the role of the reference's ``Layer`` base contract and
+``LayerRegistry`` (reference: ``caffe/include/caffe/layer.hpp``,
+``caffe/src/caffe/layer_factory.cpp:21-219``), recast functionally: a layer
+is a pure shape-to-shape transform with explicit parameter blobs, applied
+under ``jit``/``grad`` — no Forward/Backward pairs, no CPU/GPU dispatch
+(XLA owns the backend), no mutable state.
+
+Blob layout parity: each layer exposes an ordered blob list exactly like the
+reference's ``layer->blobs()`` (e.g. Convolution = [weight, bias]); BatchNorm
+keeps its [mean, variance, scale_factor] stat blobs.  That ordering is the
+contract that makes weight import/export and the WeightCollection-style
+averaging API line up with the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+
+from sparknet_tpu.config.schema import FillerParameter, LayerParameter
+from sparknet_tpu.ops import fillers
+
+Shape = Tuple[int, ...]
+
+
+@dataclasses.dataclass
+class BlobDef:
+    """One parameter/stat blob of a layer (ordered like Caffe's blobs_)."""
+
+    shape: Shape
+    filler: Optional[FillerParameter] = None
+    lr_mult: float = 1.0
+    decay_mult: float = 1.0
+    learnable: bool = True  # False => stat blob (e.g. BN moving stats)
+
+
+class Layer:
+    """Base layer. Subclasses override ``blob_defs``, ``out_shapes`` and
+    ``apply``."""
+
+    TYPE: str = ""
+    # loss layers get an implicit loss_weight of 1 on their first top
+    # (reference: layer.hpp SetLossWeights + layer type name convention)
+    IS_LOSS: bool = False
+
+    def __init__(self, lp: LayerParameter, phase: str):
+        self.lp = lp
+        self.phase = phase
+        self.name = lp.name or lp.type
+
+    # -- setup ------------------------------------------------------------
+    def blob_defs(self, bottom_shapes: Sequence[Shape]) -> List[BlobDef]:
+        return []
+
+    def out_shapes(self, bottom_shapes: Sequence[Shape]) -> List[Shape]:
+        raise NotImplementedError
+
+    def init_blobs(self, key, bottom_shapes: Sequence[Shape]):
+        defs = self.blob_defs(bottom_shapes)
+        keys = jax.random.split(key, max(1, len(defs)))
+        return [fillers.fill(k, d.shape, d.filler) for k, d in zip(keys, defs)]
+
+    # -- execution --------------------------------------------------------
+    def apply(
+        self,
+        blobs: List[jnp.ndarray],
+        bottoms: List[jnp.ndarray],
+        rng: Optional[jax.Array],
+        train: bool,
+    ) -> Tuple[List[jnp.ndarray], Optional[List[jnp.ndarray]]]:
+        """Return (tops, updated_stat_blobs_or_None).
+
+        ``blobs`` is the layer's full ordered blob list.  Layers with
+        non-learnable stat blobs (BatchNorm) return the updated full blob
+        list as the second element when training; everyone else returns
+        None.
+        """
+        raise NotImplementedError
+
+    # -- loss weights -----------------------------------------------------
+    def loss_weights(self) -> List[float]:
+        n_top = max(1, len(self.lp.top))
+        if self.lp.loss_weight:
+            w = list(self.lp.loss_weight)
+            if len(w) < n_top:
+                w += [0.0] * (n_top - len(w))
+            return w
+        return [1.0 if (self.IS_LOSS and i == 0) else 0.0 for i in range(n_top)]
+
+
+LAYER_REGISTRY: Dict[str, Type[Layer]] = {}
+
+
+def register(cls: Type[Layer]) -> Type[Layer]:
+    """``REGISTER_LAYER_CLASS`` analog (layer_factory.cpp)."""
+    assert cls.TYPE, f"{cls.__name__} missing TYPE"
+    LAYER_REGISTRY[cls.TYPE] = cls
+    return cls
+
+
+def create_layer(lp: LayerParameter, phase: str) -> Layer:
+    if lp.type not in LAYER_REGISTRY:
+        raise ValueError(
+            f"unknown layer type {lp.type!r} (layer {lp.name!r}); "
+            f"registered: {sorted(LAYER_REGISTRY)}"
+        )
+    return LAYER_REGISTRY[lp.type](lp, phase)

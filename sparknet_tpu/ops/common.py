@@ -1,0 +1,814 @@
+"""Common layers: inner product, neuron/elementwise ops, shape ops,
+normalization, embedding.
+
+Reference semantics sources (cited per class): ``caffe/src/caffe/layers/``.
+All ops are pure jnp/lax so XLA fuses the elementwise chains into their
+producer matmuls/convs — nothing here should ever be a standalone kernel on
+TPU.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from sparknet_tpu.config.schema import (
+    BatchNormParameter,
+    EltwiseParameter,
+    FillerParameter,
+    FlattenParameter,
+    MVNParameter,
+    PowerParameter,
+    ReLUParameter,
+)
+from sparknet_tpu.ops.base import BlobDef, Layer, register
+
+
+def _mults(lp, i, default_lr=1.0, default_decay=1.0):
+    if i < len(lp.param):
+        return lp.param[i].lr_mult, lp.param[i].decay_mult
+    return default_lr, default_decay
+
+
+@register
+class InnerProduct(Layer):
+    """Fully connected layer (reference: ``inner_product_layer.cpp``).
+    Flattens bottom from ``axis`` (default 1) — C-order, so NCHW weight
+    import parity holds — weight blob ``(num_output, dim)`` unless
+    ``transpose``."""
+
+    TYPE = "InnerProduct"
+
+    def _dims(self, bshape):
+        p = self.lp.inner_product_param
+        axis = p.axis % len(bshape)
+        dim = 1
+        for s in bshape[axis:]:
+            dim *= int(s)
+        return axis, dim
+
+    def blob_defs(self, bottom_shapes):
+        p = self.lp.inner_product_param
+        _, dim = self._dims(bottom_shapes[0])
+        wshape = (dim, p.num_output) if p.transpose else (p.num_output, dim)
+        wl, wd = _mults(self.lp, 0)
+        bl, bd = _mults(self.lp, 1)
+        defs = [BlobDef(wshape, p.weight_filler, wl, wd)]
+        if p.bias_term:
+            defs.append(
+                BlobDef(
+                    (p.num_output,),
+                    p.bias_filler or FillerParameter(type="constant"),
+                    bl,
+                    bd,
+                )
+            )
+        return defs
+
+    def out_shapes(self, bottom_shapes):
+        p = self.lp.inner_product_param
+        axis, _ = self._dims(bottom_shapes[0])
+        return [tuple(bottom_shapes[0][:axis]) + (p.num_output,)]
+
+    def apply(self, blobs, bottoms, rng, train):
+        p = self.lp.inner_product_param
+        axis, dim = self._dims(bottoms[0].shape)
+        x = bottoms[0].reshape(bottoms[0].shape[:axis] + (dim,))
+        w = blobs[0] if p.transpose else blobs[0].T
+        y = jnp.dot(x, w, preferred_element_type=x.dtype)
+        if p.bias_term:
+            y = y + blobs[1]
+        return [y], None
+
+
+# ---------------------------------------------------------------------------
+# Neuron layers (elementwise, one bottom -> one top)
+# ---------------------------------------------------------------------------
+
+
+@register
+class ReLU(Layer):
+    """ReLU with optional leaky slope (reference: ``relu_layer.cpp``)."""
+
+    TYPE = "ReLU"
+
+    def out_shapes(self, bottom_shapes):
+        return [bottom_shapes[0]]
+
+    def apply(self, blobs, bottoms, rng, train):
+        p = self.lp.relu_param or ReLUParameter()
+        x = bottoms[0]
+        if p.negative_slope:
+            return [jnp.where(x > 0, x, p.negative_slope * x)], None
+        return [jnp.maximum(x, 0)], None
+
+
+@register
+class Sigmoid(Layer):
+    TYPE = "Sigmoid"
+
+    def out_shapes(self, bottom_shapes):
+        return [bottom_shapes[0]]
+
+    def apply(self, blobs, bottoms, rng, train):
+        return [jax.nn.sigmoid(bottoms[0])], None
+
+
+@register
+class TanH(Layer):
+    TYPE = "TanH"
+
+    def out_shapes(self, bottom_shapes):
+        return [bottom_shapes[0]]
+
+    def apply(self, blobs, bottoms, rng, train):
+        return [jnp.tanh(bottoms[0])], None
+
+
+@register
+class AbsVal(Layer):
+    TYPE = "AbsVal"
+
+    def out_shapes(self, bottom_shapes):
+        return [bottom_shapes[0]]
+
+    def apply(self, blobs, bottoms, rng, train):
+        return [jnp.abs(bottoms[0])], None
+
+
+@register
+class BNLL(Layer):
+    """out = log(1 + exp(x)), numerically stable (reference:
+    ``bnll_layer.cpp``)."""
+
+    TYPE = "BNLL"
+
+    def out_shapes(self, bottom_shapes):
+        return [bottom_shapes[0]]
+
+    def apply(self, blobs, bottoms, rng, train):
+        x = bottoms[0]
+        return [jnp.maximum(x, 0) + jnp.log1p(jnp.exp(-jnp.abs(x)))], None
+
+
+@register
+class Power(Layer):
+    """out = (shift + scale*x)^power (reference: ``power_layer.cpp``)."""
+
+    TYPE = "Power"
+
+    def out_shapes(self, bottom_shapes):
+        return [bottom_shapes[0]]
+
+    def apply(self, blobs, bottoms, rng, train):
+        p = self.lp.power_param or PowerParameter()
+        y = p.shift + p.scale * bottoms[0]
+        if p.power != 1.0:
+            y = jnp.power(y, p.power)
+        return [y], None
+
+
+@register
+class Exp(Layer):
+    """out = base^(shift + scale*x); base -1 means e (reference:
+    ``exp_layer.cpp``)."""
+
+    TYPE = "Exp"
+
+    def out_shapes(self, bottom_shapes):
+        return [bottom_shapes[0]]
+
+    def apply(self, blobs, bottoms, rng, train):
+        p = self.lp.exp_param
+        inner = p.shift + p.scale * bottoms[0] if p else bottoms[0]
+        if p and p.base > 0:
+            return [jnp.power(p.base, inner)], None
+        return [jnp.exp(inner)], None
+
+
+@register
+class Log(Layer):
+    """out = log_base(shift + scale*x) (reference: ``log_layer.cpp``)."""
+
+    TYPE = "Log"
+
+    def out_shapes(self, bottom_shapes):
+        return [bottom_shapes[0]]
+
+    def apply(self, blobs, bottoms, rng, train):
+        p = self.lp.log_param
+        inner = p.shift + p.scale * bottoms[0] if p else bottoms[0]
+        y = jnp.log(inner)
+        if p and p.base > 0:
+            y = y / jnp.log(p.base)
+        return [y], None
+
+
+@register
+class Threshold(Layer):
+    TYPE = "Threshold"
+
+    def out_shapes(self, bottom_shapes):
+        return [bottom_shapes[0]]
+
+    def apply(self, blobs, bottoms, rng, train):
+        t = self.lp.threshold_param.threshold if self.lp.threshold_param else 0.0
+        return [(bottoms[0] > t).astype(bottoms[0].dtype)], None
+
+
+@register
+class Dropout(Layer):
+    """Inverted dropout: train scales kept units by 1/(1-ratio), test is
+    identity (reference: ``dropout_layer.cpp``)."""
+
+    TYPE = "Dropout"
+
+    def out_shapes(self, bottom_shapes):
+        return [bottom_shapes[0]]
+
+    def apply(self, blobs, bottoms, rng, train):
+        ratio = (
+            self.lp.dropout_param.dropout_ratio if self.lp.dropout_param else 0.5
+        )
+        x = bottoms[0]
+        if not train or ratio == 0.0:
+            return [x], None
+        if rng is None:
+            raise ValueError(f"dropout layer {self.name!r} needs an rng in train")
+        keep = 1.0 - ratio
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return [jnp.where(mask, x / keep, 0.0)], None
+
+
+@register
+class PReLU(Layer):
+    """Parametric ReLU; slope blob per channel or shared (reference:
+    ``prelu_layer.cpp``, default filler constant 0.25)."""
+
+    TYPE = "PReLU"
+
+    def blob_defs(self, bottom_shapes):
+        p = self.lp.prelu_param
+        shared = bool(p and p.channel_shared)
+        c = 1 if shared else bottom_shapes[0][1]
+        filler = (p.filler if p else None) or FillerParameter(
+            type="constant", value=0.25
+        )
+        lr, dc = _mults(self.lp, 0, 1.0, 0.0)
+        return [BlobDef((c,), filler, lr, dc)]
+
+    def out_shapes(self, bottom_shapes):
+        return [bottom_shapes[0]]
+
+    def apply(self, blobs, bottoms, rng, train):
+        x = bottoms[0]
+        slope = blobs[0].reshape((1, -1) + (1,) * (x.ndim - 2))
+        return [jnp.where(x > 0, x, slope * x)], None
+
+
+@register
+class ELU(Layer):
+    """Exponential linear unit — present in later reference revisions; kept
+    for zoo completeness."""
+
+    TYPE = "ELU"
+
+    def out_shapes(self, bottom_shapes):
+        return [bottom_shapes[0]]
+
+    def apply(self, blobs, bottoms, rng, train):
+        x = bottoms[0]
+        return [jnp.where(x > 0, x, jnp.expm1(x))], None
+
+
+# ---------------------------------------------------------------------------
+# Normalization
+# ---------------------------------------------------------------------------
+
+
+@register
+class BatchNorm(Layer):
+    """Caffe-style batch norm: normalizes only (pair with Scale for learned
+    affine).  Blobs are [moving_mean, moving_var, scale_factor] with lr 0 —
+    exactly the reference's stat layout (``batch_norm_layer.cpp``), so
+    .caffemodel import works.  Moving stats update functionally in train."""
+
+    TYPE = "BatchNorm"
+
+    def blob_defs(self, bottom_shapes):
+        c = bottom_shapes[0][1]
+        zero = FillerParameter(type="constant", value=0.0)
+        return [
+            BlobDef((c,), zero, 0.0, 0.0, learnable=False),
+            BlobDef((c,), zero, 0.0, 0.0, learnable=False),
+            BlobDef((1,), zero, 0.0, 0.0, learnable=False),
+        ]
+
+    def out_shapes(self, bottom_shapes):
+        return [bottom_shapes[0]]
+
+    def apply(self, blobs, bottoms, rng, train):
+        p = self.lp.batch_norm_param or BatchNormParameter()
+        x = bottoms[0]
+        use_global = (
+            p.use_global_stats if p.use_global_stats is not None else not train
+        )
+        axes = (0,) + tuple(range(2, x.ndim))
+        bshape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+        if use_global:
+            # stored stats are scaled by the accumulated factor
+            factor = jnp.where(blobs[2][0] == 0, 1.0, 1.0 / blobs[2][0])
+            mean = blobs[0] * factor
+            var = blobs[1] * factor
+            y = (x - mean.reshape(bshape)) / jnp.sqrt(var.reshape(bshape) + p.eps)
+            return [y], None
+        m = 1
+        for a in axes:
+            m *= x.shape[a]
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.mean(jnp.square(x), axis=axes) - jnp.square(mean)  # biased
+        y = (x - mean.reshape(bshape)) / jnp.sqrt(var.reshape(bshape) + p.eps)
+        # moving average update (reference keeps the running sums decayed by
+        # moving_average_fraction and divides by blobs[2] at use time)
+        lam = p.moving_average_fraction
+        bias_corr = m / max(1.0, m - 1.0)
+        new_blobs = [
+            lam * blobs[0] + mean,
+            lam * blobs[1] + bias_corr * var,
+            lam * blobs[2] + 1.0,
+        ]
+        return [y], new_blobs
+
+
+@register
+class Scale(Layer):
+    """Per-channel learned scale (optionally + bias); the affine half of
+    Caffe batch norm (reference: ``scale_layer.cpp``).  Two-bottom form
+    multiplies bottom[0] by bottom[1] broadcast from ``axis``."""
+
+    TYPE = "Scale"
+
+    def _p(self):
+        from sparknet_tpu.config.schema import ScaleParameter
+
+        return self.lp.scale_param or ScaleParameter()
+
+    def _scale_shape(self, bshape):
+        p = self._p()
+        axis = p.axis % len(bshape)
+        if p.num_axes == -1:
+            return tuple(bshape[axis:])
+        return tuple(bshape[axis : axis + p.num_axes])
+
+    def blob_defs(self, bottom_shapes):
+        if len(bottom_shapes) > 1:
+            defs = []
+        else:
+            filler = self._p().filler or FillerParameter(type="constant", value=1.0)
+            defs = [BlobDef(self._scale_shape(bottom_shapes[0]), filler, *_mults(self.lp, 0))]
+        if self._p().bias_term:
+            bias_filler = self._p().bias_filler or FillerParameter(type="constant")
+            defs.append(
+                BlobDef(
+                    self._scale_shape(bottom_shapes[0]),
+                    bias_filler,
+                    *_mults(self.lp, len(defs)),
+                )
+            )
+        return defs
+
+    def out_shapes(self, bottom_shapes):
+        return [bottom_shapes[0]]
+
+    def apply(self, blobs, bottoms, rng, train):
+        p = self._p()
+        x = bottoms[0]
+        axis = p.axis % x.ndim
+        scale = bottoms[1] if len(bottoms) > 1 else blobs[0]
+        bshape = (1,) * axis + scale.shape + (1,) * (x.ndim - axis - scale.ndim)
+        y = x * scale.reshape(bshape)
+        if p.bias_term:
+            bias = blobs[-1]
+            y = y + bias.reshape(bshape)
+        return [y], None
+
+
+@register
+class Bias(Layer):
+    """Additive counterpart of Scale (reference: ``bias_layer.cpp``)."""
+
+    TYPE = "Bias"
+
+    def _p(self):
+        from sparknet_tpu.config.schema import BiasParameter
+
+        return self.lp.bias_param or BiasParameter()
+
+    def _shape(self, bshape):
+        p = self._p()
+        axis = p.axis % len(bshape)
+        if p.num_axes == -1:
+            return tuple(bshape[axis:])
+        return tuple(bshape[axis : axis + p.num_axes])
+
+    def blob_defs(self, bottom_shapes):
+        if len(bottom_shapes) > 1:
+            return []
+        filler = self._p().filler or FillerParameter(type="constant")
+        return [BlobDef(self._shape(bottom_shapes[0]), filler, *_mults(self.lp, 0))]
+
+    def out_shapes(self, bottom_shapes):
+        return [bottom_shapes[0]]
+
+    def apply(self, blobs, bottoms, rng, train):
+        x = bottoms[0]
+        axis = self._p().axis % x.ndim
+        bias = bottoms[1] if len(bottoms) > 1 else blobs[0]
+        bshape = (1,) * axis + bias.shape + (1,) * (x.ndim - axis - bias.ndim)
+        return [x + bias.reshape(bshape)], None
+
+
+@register
+class MVN(Layer):
+    """Mean-variance normalization per sample (reference: ``mvn_layer.cpp``)."""
+
+    TYPE = "MVN"
+
+    def out_shapes(self, bottom_shapes):
+        return [bottom_shapes[0]]
+
+    def apply(self, blobs, bottoms, rng, train):
+        p = self.lp.mvn_param or MVNParameter()
+        x = bottoms[0]
+        axes = tuple(range(1, x.ndim)) if p.across_channels else tuple(
+            range(2, x.ndim)
+        )
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        y = x - mean
+        if p.normalize_variance:
+            std = jnp.sqrt(jnp.mean(jnp.square(y), axis=axes, keepdims=True))
+            y = y / (std + p.eps)
+        return [y], None
+
+
+@register
+class Softmax(Layer):
+    TYPE = "Softmax"
+
+    def out_shapes(self, bottom_shapes):
+        return [bottom_shapes[0]]
+
+    def apply(self, blobs, bottoms, rng, train):
+        axis = self.lp.softmax_param.axis if self.lp.softmax_param else 1
+        return [jax.nn.softmax(bottoms[0], axis=axis)], None
+
+
+# ---------------------------------------------------------------------------
+# Shape / combination layers
+# ---------------------------------------------------------------------------
+
+
+@register
+class Concat(Layer):
+    TYPE = "Concat"
+
+    def _axis(self, ndim):
+        p = self.lp.concat_param
+        if p and p.concat_dim is not None:
+            return p.concat_dim % ndim
+        return (p.axis if p else 1) % ndim
+
+    def out_shapes(self, bottom_shapes):
+        axis = self._axis(len(bottom_shapes[0]))
+        out = list(bottom_shapes[0])
+        out[axis] = sum(s[axis] for s in bottom_shapes)
+        return [tuple(out)]
+
+    def apply(self, blobs, bottoms, rng, train):
+        return [jnp.concatenate(bottoms, axis=self._axis(bottoms[0].ndim))], None
+
+
+@register
+class Slice(Layer):
+    TYPE = "Slice"
+
+    def _splits(self, bshape):
+        p = self.lp.slice_param
+        ndim = len(bshape)
+        axis = (
+            p.slice_dim
+            if p and p.slice_dim is not None
+            else (p.axis if p else 1)
+        ) % ndim
+        n_top = max(1, len(self.lp.top))
+        size = bshape[axis]
+        if p and p.slice_point:
+            points = list(p.slice_point)
+        else:
+            if size % n_top:
+                raise ValueError(f"Slice {self.name!r}: {size} not divisible")
+            points = [size // n_top * i for i in range(1, n_top)]
+        bounds = [0] + points + [size]
+        return axis, bounds
+
+    def out_shapes(self, bottom_shapes):
+        axis, bounds = self._splits(bottom_shapes[0])
+        outs = []
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            s = list(bottom_shapes[0])
+            s[axis] = hi - lo
+            outs.append(tuple(s))
+        return outs
+
+    def apply(self, blobs, bottoms, rng, train):
+        axis, bounds = self._splits(bottoms[0].shape)
+        tops = [
+            lax.slice_in_dim(bottoms[0], lo, hi, axis=axis)
+            for lo, hi in zip(bounds[:-1], bounds[1:])
+        ]
+        return tops, None
+
+
+@register
+class Split(Layer):
+    """Explicit fan-out (identity copies).  Autodiff already accumulates
+    gradients at fan-out points, so unlike the reference (``insert_splits
+    .cpp``) we never *insert* these — but configs that declare them work."""
+
+    TYPE = "Split"
+
+    def out_shapes(self, bottom_shapes):
+        return [bottom_shapes[0]] * max(1, len(self.lp.top))
+
+    def apply(self, blobs, bottoms, rng, train):
+        return [bottoms[0]] * max(1, len(self.lp.top)), None
+
+
+@register
+class Flatten(Layer):
+    TYPE = "Flatten"
+
+    def out_shapes(self, bottom_shapes):
+        p = self.lp.flatten_param or FlattenParameter()
+        s = bottom_shapes[0]
+        a = p.axis % len(s)
+        e = p.end_axis % len(s)
+        mid = 1
+        for d in s[a : e + 1]:
+            mid *= d
+        return [tuple(s[:a]) + (mid,) + tuple(s[e + 1 :])]
+
+    def apply(self, blobs, bottoms, rng, train):
+        return [bottoms[0].reshape(self.out_shapes([bottoms[0].shape])[0])], None
+
+
+@register
+class Reshape(Layer):
+    """Caffe reshape with 0 (copy) and -1 (infer) dims over an axis window
+    (reference: ``reshape_layer.cpp``)."""
+
+    TYPE = "Reshape"
+
+    def out_shapes(self, bottom_shapes):
+        p = self.lp.reshape_param
+        s = list(bottom_shapes[0])
+        dims = list(p.shape.dim) if p and p.shape else []
+        axis = (p.axis if p else 0) % (len(s) + 1)
+        num_axes = p.num_axes if p else -1
+        end = len(s) if num_axes == -1 else axis + num_axes
+        window = s[axis:end]
+        out_mid = []
+        infer = -1
+        for i, d in enumerate(dims):
+            if d == 0:
+                out_mid.append(window[i])
+            elif d == -1:
+                infer = i
+                out_mid.append(1)
+            else:
+                out_mid.append(d)
+        total = 1
+        for d in window:
+            total *= d
+        known = 1
+        for d in out_mid:
+            known *= d
+        if infer >= 0:
+            out_mid[infer] = total // known
+        return [tuple(s[:axis]) + tuple(out_mid) + tuple(s[end:])]
+
+    def apply(self, blobs, bottoms, rng, train):
+        return [bottoms[0].reshape(self.out_shapes([bottoms[0].shape])[0])], None
+
+
+@register
+class Eltwise(Layer):
+    """Elementwise PROD/SUM/MAX with coefficients (reference:
+    ``eltwise_layer.cpp``)."""
+
+    TYPE = "Eltwise"
+
+    def out_shapes(self, bottom_shapes):
+        return [bottom_shapes[0]]
+
+    def apply(self, blobs, bottoms, rng, train):
+        p = self.lp.eltwise_param or EltwiseParameter()
+        op = p.operation.upper()
+        if op == "PROD":
+            y = bottoms[0]
+            for b in bottoms[1:]:
+                y = y * b
+        elif op == "SUM":
+            coeffs = list(p.coeff) or [1.0] * len(bottoms)
+            if len(coeffs) != len(bottoms):
+                raise ValueError(
+                    f"Eltwise {self.name!r}: {len(coeffs)} coeffs for "
+                    f"{len(bottoms)} bottoms (must match or be omitted)"
+                )
+            y = coeffs[0] * bottoms[0]
+            for c, b in zip(coeffs[1:], bottoms[1:]):
+                y = y + c * b
+        elif op == "MAX":
+            y = bottoms[0]
+            for b in bottoms[1:]:
+                y = jnp.maximum(y, b)
+        else:
+            raise ValueError(f"unknown eltwise op {p.operation!r}")
+        return [y], None
+
+
+@register
+class Tile(Layer):
+    TYPE = "Tile"
+
+    def out_shapes(self, bottom_shapes):
+        p = self.lp.tile_param
+        s = list(bottom_shapes[0])
+        axis = p.axis % len(s)
+        s[axis] *= p.tiles
+        return [tuple(s)]
+
+    def apply(self, blobs, bottoms, rng, train):
+        p = self.lp.tile_param
+        axis = p.axis % bottoms[0].ndim
+        reps = [1] * bottoms[0].ndim
+        reps[axis] = p.tiles
+        return [jnp.tile(bottoms[0], reps)], None
+
+
+@register
+class Reduction(Layer):
+    """Reduce trailing axes from ``axis`` (reference: ``reduction_layer
+    .cpp``): SUM | ASUM | SUMSQ | MEAN, scaled by coeff."""
+
+    TYPE = "Reduction"
+
+    def out_shapes(self, bottom_shapes):
+        p = self.lp.reduction_param
+        axis = (p.axis if p else 0) % len(bottom_shapes[0])
+        return [tuple(bottom_shapes[0][:axis])]
+
+    def apply(self, blobs, bottoms, rng, train):
+        from sparknet_tpu.config.schema import ReductionParameter
+
+        p = self.lp.reduction_param or ReductionParameter()
+        x = bottoms[0]
+        axis = p.axis % x.ndim
+        axes = tuple(range(axis, x.ndim))
+        op = p.operation.upper()
+        if op == "SUM":
+            y = jnp.sum(x, axis=axes)
+        elif op == "ASUM":
+            y = jnp.sum(jnp.abs(x), axis=axes)
+        elif op == "SUMSQ":
+            y = jnp.sum(jnp.square(x), axis=axes)
+        elif op == "MEAN":
+            y = jnp.mean(x, axis=axes)
+        else:
+            raise ValueError(f"unknown reduction {p.operation!r}")
+        return [p.coeff * y], None
+
+
+@register
+class ArgMax(Layer):
+    """Top-k indices (and optionally values) over the channel axis
+    (reference: ``argmax_layer.cpp``)."""
+
+    TYPE = "ArgMax"
+
+    def out_shapes(self, bottom_shapes):
+        from sparknet_tpu.config.schema import ArgMaxParameter
+
+        p = self.lp.argmax_param or ArgMaxParameter()
+        s = bottom_shapes[0]
+        if p.axis is not None:
+            out = list(s)
+            out[p.axis % len(s)] = p.top_k
+            return [tuple(out)]
+        pair = 2 if p.out_max_val else 1
+        return [(s[0], pair, p.top_k)]
+
+    def apply(self, blobs, bottoms, rng, train):
+        from sparknet_tpu.config.schema import ArgMaxParameter
+
+        p = self.lp.argmax_param or ArgMaxParameter()
+        x = bottoms[0]
+        if p.axis is not None:
+            axis = p.axis % x.ndim
+            moved = jnp.moveaxis(x, axis, -1)
+            vals, idx = lax.top_k(moved, p.top_k)
+            out = vals if p.out_max_val else idx.astype(x.dtype)
+            return [jnp.moveaxis(out, -1, axis)], None
+        flat = x.reshape(x.shape[0], -1)
+        vals, idx = lax.top_k(flat, p.top_k)
+        idxf = idx.astype(x.dtype)
+        if p.out_max_val:
+            return [jnp.stack([idxf, vals], axis=1)], None
+        return [idxf[:, None, :]], None
+
+
+@register
+class Embed(Layer):
+    """Embedding lookup; weight blob ``(input_dim, num_output)`` (reference:
+    ``embed_layer.cpp``)."""
+
+    TYPE = "Embed"
+
+    def blob_defs(self, bottom_shapes):
+        p = self.lp.embed_param
+        defs = [
+            BlobDef((p.input_dim, p.num_output), p.weight_filler, *_mults(self.lp, 0))
+        ]
+        if p.bias_term:
+            defs.append(
+                BlobDef(
+                    (p.num_output,),
+                    p.bias_filler or FillerParameter(type="constant"),
+                    *_mults(self.lp, 1),
+                )
+            )
+        return defs
+
+    def out_shapes(self, bottom_shapes):
+        p = self.lp.embed_param
+        return [tuple(bottom_shapes[0]) + (p.num_output,)]
+
+    def apply(self, blobs, bottoms, rng, train):
+        p = self.lp.embed_param
+        idx = bottoms[0].astype(jnp.int32)
+        y = jnp.take(blobs[0], idx, axis=0)
+        if p.bias_term:
+            y = y + blobs[1]
+        return [y], None
+
+
+@register
+class BatchReindex(Layer):
+    """Gather rows of bottom[0] by the (static-shape) index blob bottom[1]
+    (reference: ``batch_reindex_layer.cpp``)."""
+
+    TYPE = "BatchReindex"
+
+    def out_shapes(self, bottom_shapes):
+        return [(bottom_shapes[1][0],) + tuple(bottom_shapes[0][1:])]
+
+    def apply(self, blobs, bottoms, rng, train):
+        idx = bottoms[1].reshape(-1).astype(jnp.int32)
+        return [jnp.take(bottoms[0], idx, axis=0)], None
+
+
+@register
+class Silence(Layer):
+    """Consumes bottoms, produces nothing (reference: ``silence_layer
+    .cpp``)."""
+
+    TYPE = "Silence"
+
+    def out_shapes(self, bottom_shapes):
+        return []
+
+    def apply(self, blobs, bottoms, rng, train):
+        return [], None
+
+
+@register
+class Filter(Layer):
+    """Dynamic-shape selection is incompatible with XLA static shapes; the
+    masked equivalent keeps shapes static by zeroing unselected items.
+    Documented deviation from ``filter_layer.cpp``."""
+
+    TYPE = "Filter"
+
+    def out_shapes(self, bottom_shapes):
+        return list(bottom_shapes[:-1])
+
+    def apply(self, blobs, bottoms, rng, train):
+        sel = bottoms[-1].reshape(-1)
+        outs = []
+        for b in bottoms[:-1]:
+            mask = sel.reshape((-1,) + (1,) * (b.ndim - 1))
+            outs.append(b * (mask > 0))
+        return outs, None

@@ -1,0 +1,172 @@
+"""Data-source layers.
+
+The reference's callback-pull JavaDataLayer (``caffe/src/caffe/layers/
+java_data_layer.cpp``: engine calls back into the JVM to fill a host buffer
+every forward) inverts here into the idiomatic TPU pattern: the host input
+pipeline *pushes* ready batches, and data layers simply bind those arrays to
+their top names inside the jitted step.  ``HostData`` is the JavaData/RDDLayer
+equivalent; ``Data``/``ImageData``/``HDF5Data``/``MemoryData``/``WindowData``
+all become host-fed at execution time (their pipeline configs are consumed by
+``sparknet_tpu.data``), so one mechanism covers the whole reference data-layer
+family.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from sparknet_tpu.ops import fillers
+from sparknet_tpu.ops.base import Layer, Shape, register
+
+
+class _HostFed(Layer):
+    """Tops come from the externally supplied batch dict, keyed by top
+    name.  Shape comes from the layer config when available, else from the
+    net's feed_shapes."""
+
+    def declared_shapes(self) -> List[Shape] | None:
+        return None
+
+    def out_shapes(self, bottom_shapes):
+        shapes = self.declared_shapes()
+        if shapes is None:
+            raise ValueError(
+                f"layer {self.name!r} ({self.TYPE}) needs feed shapes: pass "
+                f"feed_shapes={{top: shape}} to the net, or declare them in "
+                f"the layer config"
+            )
+        return shapes
+
+    def apply(self, blobs, bottoms, rng, train):
+        raise RuntimeError(
+            f"data layer {self.name!r} tops must be bound from the batch"
+        )
+
+
+@register
+class HostData(_HostFed):
+    """The JavaData/RDDLayer equivalent: shapes declared inline via
+    ``java_data_param.shape`` (reference: JavaDataParameter,
+    caffe.proto:991-993)."""
+
+    TYPE = "HostData"
+
+    def declared_shapes(self):
+        p = self.lp.java_data_param
+        if p and p.shape:
+            return [tuple(int(d) for d in s.dim) for s in p.shape]
+        return None
+
+
+@register
+class JavaData(HostData):
+    """Alias so reference configs naming JavaData load unchanged."""
+
+    TYPE = "JavaData"
+
+
+@register
+class Input(_HostFed):
+    TYPE = "Input"
+
+    def declared_shapes(self):
+        p = self.lp.input_param
+        if p and p.shape:
+            return [tuple(int(d) for d in s.dim) for s in p.shape]
+        return None
+
+
+@register
+class Data(_HostFed):
+    """DB-backed data layer (reference: ``data_layer.cpp``); the DB read +
+    transform pipeline lives host-side in ``sparknet_tpu.data.db``."""
+
+    TYPE = "Data"
+
+
+@register
+class ImageData(_HostFed):
+    TYPE = "ImageData"
+
+
+@register
+class WindowData(_HostFed):
+    TYPE = "WindowData"
+
+
+@register
+class HDF5Data(_HostFed):
+    TYPE = "HDF5Data"
+
+
+@register
+class MemoryData(_HostFed):
+    TYPE = "MemoryData"
+
+    def declared_shapes(self):
+        p = self.lp.memory_data_param
+        if p and p.batch_size:
+            return [
+                (p.batch_size, p.channels, p.height, p.width),
+                (p.batch_size,),
+            ]
+        return None
+
+
+@register
+class DummyData(Layer):
+    """Filler-generated data (reference: ``dummy_data_layer.cpp``).  Constant
+    fillers refill identically every step; random fillers draw from a key
+    folded per step."""
+
+    TYPE = "DummyData"
+
+    def _shapes(self):
+        p = self.lp.dummy_data_param
+        if p.shape:
+            return [tuple(int(d) for d in s.dim) for s in p.shape]
+        shapes = []
+        for i in range(max(len(p.num), 1)):
+            shapes.append(
+                (
+                    p.num[i] if i < len(p.num) else p.num[-1],
+                    p.channels[i] if i < len(p.channels) else p.channels[-1],
+                    p.height[i] if i < len(p.height) else p.height[-1],
+                    p.width[i] if i < len(p.width) else p.width[-1],
+                )
+            )
+        return shapes
+
+    def out_shapes(self, bottom_shapes):
+        return self._shapes()
+
+    def apply(self, blobs, bottoms, rng, train):
+        p = self.lp.dummy_data_param
+        shapes = self._shapes()
+        tops = []
+        base = rng if rng is not None else jax.random.PRNGKey(0)
+        for i, shape in enumerate(shapes):
+            filler = (
+                p.data_filler[i]
+                if i < len(p.data_filler)
+                else (p.data_filler[-1] if p.data_filler else None)
+            )
+            tops.append(fillers.fill(jax.random.fold_in(base, i), shape, filler))
+        return tops, None
+
+
+@register
+class HDF5Output(Layer):
+    """Sink layer; host-side writer consumes the tapped blobs instead
+    (activation taps replace the in-graph file write)."""
+
+    TYPE = "HDF5Output"
+
+    def out_shapes(self, bottom_shapes):
+        return []
+
+    def apply(self, blobs, bottoms, rng, train):
+        return [], None

@@ -1,0 +1,114 @@
+"""Weight initializers matching the reference's filler semantics.
+
+Reference: ``caffe/include/caffe/filler.hpp:31-287`` — seven filler types
+selected by string, with the same fan computations:
+``fan_in = count / shape[0]``, ``fan_out = count / shape[1]`` (for a conv
+weight ``(out, in/g, kh, kw)`` that is ``in/g*kh*kw`` and ``out`` is folded
+with the spatial dims, exactly as the reference computes them).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparknet_tpu.config.schema import FillerParameter
+
+__all__ = ["fill", "FILLERS"]
+
+
+def _fans(shape: Sequence[int]):
+    count = int(np.prod(shape)) if shape else 1
+    fan_in = count // shape[0] if len(shape) >= 1 and shape[0] else count
+    fan_out = count // shape[1] if len(shape) >= 2 and shape[1] else count
+    return fan_in, fan_out
+
+
+def _scale_n(p: FillerParameter, shape) -> float:
+    fan_in, fan_out = _fans(shape)
+    norm = (p.variance_norm or "FAN_IN").upper()
+    if norm == "FAN_IN":
+        return float(fan_in)
+    if norm == "FAN_OUT":
+        return float(fan_out)
+    if norm == "AVERAGE":
+        return (fan_in + fan_out) / 2.0
+    raise ValueError(f"unknown variance_norm {p.variance_norm!r}")
+
+
+def _constant(key, shape, p, dtype):
+    return jnp.full(shape, p.value, dtype=dtype)
+
+
+def _uniform(key, shape, p, dtype):
+    return jax.random.uniform(key, shape, dtype=dtype, minval=p.min, maxval=p.max)
+
+
+def _gaussian(key, shape, p, dtype):
+    k1, k2 = jax.random.split(key)
+    x = p.mean + p.std * jax.random.normal(k1, shape, dtype=dtype)
+    if p.sparse >= 0:
+        # keep ~sparse non-zeros per output unit (bernoulli over fan-in,
+        # reference: filler.hpp GaussianFiller sparse_ handling)
+        fan_in, _ = _fans(shape)
+        prob = min(1.0, p.sparse / max(1, fan_in))
+        mask = jax.random.bernoulli(k2, prob, shape)
+        x = x * mask
+    return x
+
+
+def _positive_unitball(key, shape, p, dtype):
+    # uniform [0,1), then every shape[0]-slice normalized to sum to 1
+    x = jax.random.uniform(key, shape, dtype=dtype)
+    flat = x.reshape(shape[0], -1)
+    flat = flat / jnp.sum(flat, axis=1, keepdims=True)
+    return flat.reshape(shape)
+
+
+def _xavier(key, shape, p, dtype):
+    scale = math.sqrt(3.0 / _scale_n(p, shape))
+    return jax.random.uniform(key, shape, dtype=dtype, minval=-scale, maxval=scale)
+
+
+def _msra(key, shape, p, dtype):
+    std = math.sqrt(2.0 / _scale_n(p, shape))
+    return std * jax.random.normal(key, shape, dtype=dtype)
+
+
+def _bilinear(key, shape, p, dtype):
+    # upsampling kernel for deconvolution (reference: filler.hpp BilinearFiller)
+    if len(shape) != 4 or shape[2] != shape[3]:
+        raise ValueError("bilinear filler expects a square 4-D kernel")
+    k = shape[3]
+    f = math.ceil(k / 2.0)
+    c = (2 * f - 1 - f % 2) / (2.0 * f)
+    idx = np.arange(k)
+    w1d = 1 - np.abs(idx / f - c)
+    w2d = np.outer(w1d, w1d)
+    return jnp.broadcast_to(jnp.asarray(w2d, dtype=dtype), shape)
+
+
+FILLERS = {
+    "constant": _constant,
+    "uniform": _uniform,
+    "gaussian": _gaussian,
+    "positive_unitball": _positive_unitball,
+    "xavier": _xavier,
+    "msra": _msra,
+    "bilinear": _bilinear,
+}
+
+
+def fill(key, shape: Sequence[int], p: FillerParameter | None, dtype=jnp.float32):
+    """Initialize an array of ``shape`` per the filler config (constant 0 if
+    no filler is given, matching the reference default)."""
+    p = p or FillerParameter()
+    try:
+        fn = FILLERS[p.type]
+    except KeyError:
+        raise ValueError(f"unknown filler type {p.type!r}") from None
+    return fn(key, tuple(int(s) for s in shape), p, dtype)

@@ -1,0 +1,240 @@
+"""Loss and evaluation layers.
+
+Normalization semantics follow the reference exactly (``loss_layer.cpp``,
+``softmax_loss_layer.cpp``): default VALID (divide by non-ignored count),
+legacy ``normalize: false`` means BATCH_SIZE, FULL divides by outer*inner,
+NONE by 1.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from sparknet_tpu.config.schema import LossParameter
+from sparknet_tpu.ops.base import Layer, register
+
+
+def _loss_param(lp) -> LossParameter:
+    return lp.loss_param or LossParameter()
+
+
+def _normalization(p: LossParameter) -> str:
+    if p.normalize is not None and p.normalization == "VALID":
+        return "VALID" if p.normalize else "BATCH_SIZE"
+    return p.normalization.upper()
+
+
+def _normalizer(norm: str, outer: int, inner, valid_count):
+    if norm == "FULL":
+        return jnp.asarray(outer * inner, jnp.float32)
+    if norm == "VALID":
+        return jnp.maximum(valid_count.astype(jnp.float32), 1.0)
+    if norm == "BATCH_SIZE":
+        return jnp.asarray(outer, jnp.float32)
+    if norm == "NONE":
+        return jnp.asarray(1.0, jnp.float32)
+    raise ValueError(f"unknown loss normalization {norm!r}")
+
+
+@register
+class SoftmaxWithLoss(Layer):
+    """Softmax + multinomial NLL with ignore_label (reference:
+    ``softmax_loss_layer.cpp``).  Softmax axis default 1; labels index that
+    axis; outer = dims before axis, inner = dims after."""
+
+    TYPE = "SoftmaxWithLoss"
+    IS_LOSS = True
+
+    def out_shapes(self, bottom_shapes):
+        outs = [()]
+        if len(self.lp.top) > 1:
+            outs.append(bottom_shapes[0])  # optional softmax top
+        return outs
+
+    def apply(self, blobs, bottoms, rng, train):
+        logits, labels = bottoms[0], bottoms[1]
+        p = _loss_param(self.lp)
+        axis = self.lp.softmax_param.axis if self.lp.softmax_param else 1
+        axis = axis % logits.ndim
+        logp = jax.nn.log_softmax(logits, axis=axis)
+        lab = labels.astype(jnp.int32)
+        # move class axis last for take_along_axis
+        moved = jnp.moveaxis(logp, axis, -1)
+        lab_b = lab.reshape(moved.shape[:-1])
+        picked = jnp.take_along_axis(
+            moved, jnp.clip(lab_b, 0, moved.shape[-1] - 1)[..., None], axis=-1
+        )[..., 0]
+        if p.ignore_label is not None:
+            valid = lab_b != p.ignore_label
+            picked = jnp.where(valid, picked, 0.0)
+            valid_count = jnp.sum(valid)
+        else:
+            valid_count = jnp.asarray(picked.size)
+        outer = 1
+        for d in logits.shape[:axis]:
+            outer *= d
+        inner = picked.size // max(1, outer)
+        norm = _normalizer(_normalization(p), outer, inner, valid_count)
+        loss = -jnp.sum(picked) / norm
+        tops = [loss]
+        if len(self.lp.top) > 1:
+            tops.append(jnp.exp(logp))
+        return tops, None
+
+
+@register
+class SigmoidCrossEntropyLoss(Layer):
+    """Stable sigmoid cross-entropy summed over all elements / outer count
+    (reference: ``sigmoid_cross_entropy_loss_layer.cpp`` — normalizes by
+    batch size)."""
+
+    TYPE = "SigmoidCrossEntropyLoss"
+    IS_LOSS = True
+
+    def out_shapes(self, bottom_shapes):
+        return [()]
+
+    def apply(self, blobs, bottoms, rng, train):
+        x, t = bottoms[0], bottoms[1]
+        per = jnp.maximum(x, 0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        return [jnp.sum(per) / x.shape[0]], None
+
+
+@register
+class EuclideanLoss(Layer):
+    """0.5 * ||a - b||^2 / N (reference: ``euclidean_loss_layer.cpp``)."""
+
+    TYPE = "EuclideanLoss"
+    IS_LOSS = True
+
+    def out_shapes(self, bottom_shapes):
+        return [()]
+
+    def apply(self, blobs, bottoms, rng, train):
+        d = bottoms[0] - bottoms[1]
+        return [0.5 * jnp.sum(d * d) / d.shape[0]], None
+
+
+@register
+class HingeLoss(Layer):
+    """One-vs-all hinge loss, L1 or L2 (reference: ``hinge_loss_layer
+    .cpp``)."""
+
+    TYPE = "HingeLoss"
+    IS_LOSS = True
+
+    def out_shapes(self, bottom_shapes):
+        return [()]
+
+    def apply(self, blobs, bottoms, rng, train):
+        x, label = bottoms[0], bottoms[1].astype(jnp.int32)
+        n = x.shape[0]
+        flat = x.reshape(n, -1)
+        sign = jnp.where(
+            jax.nn.one_hot(label.reshape(n), flat.shape[1], dtype=flat.dtype) > 0,
+            -1.0,
+            1.0,
+        )
+        margins = jnp.maximum(0.0, 1.0 + sign * flat)
+        p = self.lp.hinge_loss_param
+        if p and p.norm.upper() == "L2":
+            return [jnp.sum(margins * margins) / n], None
+        return [jnp.sum(margins) / n], None
+
+
+@register
+class MultinomialLogisticLoss(Layer):
+    """NLL on already-normalized probabilities (reference:
+    ``multinomial_logistic_loss_layer.cpp``)."""
+
+    TYPE = "MultinomialLogisticLoss"
+    IS_LOSS = True
+
+    def out_shapes(self, bottom_shapes):
+        return [()]
+
+    def apply(self, blobs, bottoms, rng, train):
+        prob, label = bottoms[0], bottoms[1].astype(jnp.int32)
+        n = prob.shape[0]
+        flat = prob.reshape(n, -1)
+        picked = jnp.take_along_axis(flat, label.reshape(n, 1), axis=1)
+        return [-jnp.sum(jnp.log(jnp.maximum(picked, 1e-20))) / n], None
+
+
+@register
+class InfogainLoss(Layer):
+    """NLL weighted by an infogain matrix H, fed as a third bottom
+    (reference: ``infogain_loss_layer.cpp``; the file-sourced H variant is
+    handled by the net builder loading the matrix into a bottom)."""
+
+    TYPE = "InfogainLoss"
+    IS_LOSS = True
+
+    def out_shapes(self, bottom_shapes):
+        return [()]
+
+    def apply(self, blobs, bottoms, rng, train):
+        prob, label = bottoms[0], bottoms[1].astype(jnp.int32)
+        if len(bottoms) < 3:
+            raise ValueError(
+                f"InfogainLoss {self.name!r}: infogain matrix must be a bottom"
+            )
+        H = bottoms[2].reshape(bottoms[2].shape[-2:])
+        n = prob.shape[0]
+        flat = prob.reshape(n, -1)
+        rows = jnp.take(H, label.reshape(n), axis=0)  # (n, K)
+        return [-jnp.sum(rows * jnp.log(jnp.maximum(flat, 1e-20))) / n], None
+
+
+@register
+class ContrastiveLoss(Layer):
+    """Siamese contrastive loss (reference: ``contrastive_loss_layer.cpp``),
+    incl. the legacy_version distance-vs-squared-distance switch."""
+
+    TYPE = "ContrastiveLoss"
+    IS_LOSS = True
+
+    def out_shapes(self, bottom_shapes):
+        return [()]
+
+    def apply(self, blobs, bottoms, rng, train):
+        from sparknet_tpu.config.schema import ContrastiveLossParameter
+
+        p = self.lp.contrastive_loss_param or ContrastiveLossParameter()
+        a, b, y = bottoms[0], bottoms[1], bottoms[2].reshape(-1)
+        d2 = jnp.sum(jnp.square(a - b), axis=1)
+        d = jnp.sqrt(jnp.maximum(d2, 1e-12))
+        if p.legacy_version:
+            mismatch = jnp.maximum(p.margin - d2, 0.0)
+        else:
+            mismatch = jnp.square(jnp.maximum(p.margin - d, 0.0))
+        per = y * d2 + (1.0 - y) * mismatch
+        return [jnp.sum(per) / (2.0 * a.shape[0])], None
+
+
+@register
+class Accuracy(Layer):
+    """Top-k accuracy with ignore_label (reference: ``accuracy_layer.cpp``).
+    Never a loss (loss_weight 0 by default)."""
+
+    TYPE = "Accuracy"
+
+    def out_shapes(self, bottom_shapes):
+        return [()]
+
+    def apply(self, blobs, bottoms, rng, train):
+        from sparknet_tpu.config.schema import AccuracyParameter
+
+        p = self.lp.accuracy_param or AccuracyParameter()
+        x, label = bottoms[0], bottoms[1].astype(jnp.int32)
+        axis = p.axis % x.ndim
+        moved = jnp.moveaxis(x, axis, -1)
+        lab = label.reshape(moved.shape[:-1])
+        _, topk = lax.top_k(moved, min(p.top_k, moved.shape[-1]))
+        hit = jnp.any(topk == lab[..., None], axis=-1).astype(jnp.float32)
+        if p.ignore_label is not None:
+            valid = (lab != p.ignore_label).astype(jnp.float32)
+            return [jnp.sum(hit * valid) / jnp.maximum(jnp.sum(valid), 1.0)], None
+        return [jnp.mean(hit)], None

@@ -3,8 +3,12 @@
 Multi-chip sharding is validated the way SURVEY.md §4 prescribes for a
 single-host environment: ``--xla_force_host_platform_device_count=8`` gives
 jax 8 CPU devices, so every pjit/shard_map path compiles and executes with a
-real (virtual) mesh.  Must run before jax initializes a backend, hence the
-env mutation at import time.
+real (virtual) mesh.
+
+The axon TPU tunnel registers itself via sitecustomize at interpreter start
+and pins ``JAX_PLATFORMS=axon``, so plain env vars are not enough — we must
+flip the already-imported jax config back to cpu before the first backend
+use (conftest imports run before any test touches a device).
 """
 
 import os
@@ -14,9 +18,13 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
