@@ -1,0 +1,71 @@
+"""Headline benchmark: AlexNet training throughput on one TPU chip.
+
+Protocol matches the reference's hardware table (``caffe/docs/
+performance_hardware.md:20-25``): time 20 training iterations at batch 256
+(5120 images) — the K40+cuDNN baseline is 19.2 s, i.e. ~267 img/s.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+BASELINE_IMG_S = 5120.0 / 19.2  # reference K40+cuDNN
+
+
+def main():
+    import jax
+    import numpy as np
+
+    from sparknet_tpu import models
+    from sparknet_tpu.config import load_solver_prototxt, replace_data_layers
+    from sparknet_tpu.solver import Solver
+
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
+
+    netp = replace_data_layers(
+        models.load_model("alexnet"),
+        [(batch, 3, 227, 227), (batch,)],
+        [(batch, 3, 227, 227), (batch,)],
+    )
+    solver = Solver(models.load_model_solver("alexnet"), net_param=netp)
+    state = solver.init_state(seed=0)
+
+    rng = np.random.RandomState(0)
+    host_batch = {
+        "data": rng.randn(1, batch, 3, 227, 227).astype(np.float32),
+        "label": rng.randint(0, 1000, (1, batch)).astype(np.float32),
+    }
+    dev_batch = jax.device_put(host_batch)
+
+    # warmup: compile + one step
+    state, losses = solver.step(state, dev_batch)
+    jax.block_until_ready(losses)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, losses = solver.step(state, dev_batch)
+    jax.block_until_ready(losses)
+    elapsed = time.perf_counter() - t0
+
+    img_s = batch * iters / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "alexnet_train_images_per_sec",
+                "value": round(img_s, 1),
+                "unit": "img/s",
+                "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,16 @@
+"""Host-side data plane.
+
+Replaces the reference's two data paths with one idiomatic TPU pattern:
+
+- RDD-of-minibatches + callback pull (``MinibatchSampler.scala``,
+  ``JavaDataLayer``)  ->  per-host iterators yielding ready numpy batches,
+  stacked tau-deep per averaging round and pushed to device.
+- DB path (LevelDB/LMDB + ``DataReader`` + ``BasePrefetchingDataLayer``)  ->
+  the same prefetch thread + bounded-queue double-buffering here; the
+  record-DB storage itself ships with the native runtime component.
+"""
+
+from sparknet_tpu.data.cifar import CifarLoader  # noqa: F401
+from sparknet_tpu.data.sampler import MinibatchSampler  # noqa: F401
+from sparknet_tpu.data.transformer import DataTransformer  # noqa: F401
+from sparknet_tpu.data.prefetch import Prefetcher, device_prefetch  # noqa: F401

@@ -1,0 +1,106 @@
+"""Async host->device prefetch.
+
+Reference: ``BasePrefetchingDataLayer`` keeps PREFETCH_COUNT=3 batches in
+flight on an InternalThread with an async H2D push (``base_data_layer.cpp:
+70-101``); ``BlockingQueue`` provides the handshake.  Here the same
+double-buffering is a producer thread + bounded queue, and the device push
+is ``jax.device_put`` (which on TPU overlaps with compute because transfers
+are async until the buffer is used).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, Iterator, Optional
+
+import jax
+import numpy as np
+
+PREFETCH_COUNT = 3  # reference: data_layers.hpp PREFETCH_COUNT
+
+
+class Prefetcher:
+    """Wraps a batch-producing callable in a background thread with a
+    bounded queue (the InternalThread + BlockingQueue pair)."""
+
+    def __init__(
+        self,
+        produce: Callable[[], Dict[str, np.ndarray]],
+        depth: int = PREFETCH_COUNT,
+        device_put: bool = True,
+        sharding=None,
+    ):
+        self._produce = produce
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._done = False
+        self._error: Optional[BaseException] = None
+        self._device_put = device_put
+        self._sharding = sharding
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        try:
+            while not self._stop.is_set():
+                batch = self._produce()
+                if batch is None:
+                    self._q.put(None)
+                    return
+                if self._device_put:
+                    batch = (
+                        jax.device_put(batch, self._sharding)
+                        if self._sharding is not None
+                        else jax.device_put(batch)
+                    )
+                # block politely so stop() can interrupt
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(batch, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # surfaced on next __next__
+            self._error = e
+            self._q.put(None)
+
+    def __iter__(self) -> Iterator:
+        return self
+
+    def __next__(self):
+        if self._done:
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        item = self._q.get()
+        if item is None:
+            self._done = True  # sticky: keep raising after exhaustion/error
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        return item
+
+    def stop(self):
+        self._stop.set()
+        # drain so the producer unblocks
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+
+
+def device_prefetch(iterator, depth: int = 2, sharding=None):
+    """Prefetch an existing host iterator onto device: the idiomatic
+    flax-style device prefetch for feeding jitted steps without stalls."""
+    it = iter(iterator)
+
+    def produce():
+        try:
+            return next(it)
+        except StopIteration:
+            return None
+
+    return Prefetcher(produce, depth=depth, sharding=sharding)

@@ -1,0 +1,66 @@
+"""Model zoo: prototxt configs + programmatic DSL.
+
+``zoo/`` holds the framework-native configs of the reference's model
+families (BASELINE.json configs). ``load_model(name)`` returns the
+NetParameter; ``load_model_solver(name)`` the solver with net embedded.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+from sparknet_tpu.config import (
+    NetParameter,
+    SolverParameter,
+    load_net_prototxt,
+    load_solver_prototxt,
+)
+from sparknet_tpu.models import dsl  # noqa: F401
+
+ZOO_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "zoo")
+
+_NET_FILES = {
+    "cifar10_full": "cifar10_full_train_test.prototxt",
+    "lenet": "lenet_train_test.prototxt",
+    "alexnet": "alexnet_train_val.prototxt",
+    "caffenet": "caffenet_train_val.prototxt",
+    "googlenet": "googlenet_train_val.prototxt",
+    "resnet50": "resnet50_train_val.prototxt",
+}
+
+_SOLVER_FILES = {
+    "cifar10_full": "cifar10_full_solver.prototxt",
+    "lenet": "lenet_solver.prototxt",
+    "alexnet": "alexnet_solver.prototxt",
+    "caffenet": "caffenet_solver.prototxt",
+    "googlenet": "googlenet_solver.prototxt",
+    "resnet50": "resnet50_solver.prototxt",
+}
+
+
+def available_models() -> List[str]:
+    return sorted(
+        name
+        for name, f in _NET_FILES.items()
+        if os.path.exists(os.path.join(ZOO_DIR, f))
+    )
+
+
+def load_model(name: str) -> NetParameter:
+    if name not in _NET_FILES:
+        raise KeyError(f"unknown model {name!r}; have {sorted(_NET_FILES)}")
+    path = os.path.join(ZOO_DIR, _NET_FILES[name])
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"model config not in zoo yet: {path}")
+    return load_net_prototxt(path)
+
+
+def load_model_solver(name: str) -> SolverParameter:
+    path = os.path.join(ZOO_DIR, _SOLVER_FILES[name])
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"solver config not in zoo yet: {path}")
+    solver = load_solver_prototxt(path)
+    solver.net = None
+    solver.net_param = load_model(name)
+    return solver

@@ -1,0 +1,31 @@
+"""Distributed execution: device meshes + the two reference data-parallel
+modes, lowered to XLA collectives.
+
+Reference comm planes (SURVEY §2.4) and their TPU-native replacements:
+
+- inter-node Spark broadcast/reduce parameter averaging
+  (``CifarApp.scala:95-136``)  ->  ``ParameterAveragingTrainer``:
+  tau jitted local steps per worker, then ``pmean(params)`` over the ``dp``
+  mesh axis riding ICI/DCN — the driver<->executor round trip and the
+  2x|theta|xN floats through the driver disappear entirely.
+- in-node P2PSync GPU tree allreduce (``caffe/src/caffe/parallel.cpp``)  ->
+  ``AllReduceTrainer``: per-step gradient ``psum`` — one mechanism covers
+  both of the reference's topologies.
+
+Multi-host: the same code runs under ``jax.distributed.initialize`` — the
+mesh just spans hosts, and XLA routes collectives over ICI within a slice
+and DCN across slices.
+"""
+
+from sparknet_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    local_device_count,
+    initialize_distributed,
+)
+from sparknet_tpu.parallel.trainers import (  # noqa: F401
+    AllReduceTrainer,
+    ParameterAveragingTrainer,
+    first_worker,
+    replicate,
+    shard_leading,
+)

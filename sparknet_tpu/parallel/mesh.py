@@ -1,0 +1,61 @@
+"""Device-mesh construction.
+
+The reference discovers comm topology by probing GPU boards and P2P
+reachability (``parallel.cpp:115-197 DevicePair::compute``); on TPU the
+topology is the pod slice itself — we just lay axes over
+``jax.devices()``: ``dp`` (data/worker axis, the Spark-executor analog),
+``mp`` (model/tensor axis), with room for ``sp``/``pp``/``ep`` as models
+need them.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def make_mesh(
+    axes: Optional[Dict[str, int]] = None, devices: Optional[Sequence] = None
+) -> Mesh:
+    """Build a Mesh from an {axis: size} dict; a -1 size absorbs the
+    remaining devices (e.g. {"dp": -1, "mp": 2})."""
+    devices = list(devices if devices is not None else jax.devices())
+    axes = dict(axes or {"dp": len(devices)})
+    sizes = list(axes.values())
+    n_fixed = int(np.prod([s for s in sizes if s > 0])) or 1
+    if any(s == -1 for s in sizes):
+        if len(devices) % n_fixed:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by fixed axes {axes}"
+            )
+        sizes = [s if s > 0 else len(devices) // n_fixed for s in sizes]
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(f"mesh {axes} needs {total} devices, have {len(devices)}")
+    arr = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(arr, tuple(axes.keys()))
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host bring-up (the Spark-cluster analog): each host process
+    calls this, then ``jax.devices()`` spans the whole slice and every
+    mesh/collective below works unchanged across hosts."""
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs = dict(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    jax.distributed.initialize(**kwargs)

@@ -1,0 +1,141 @@
+"""End-to-end CIFAR slice (SURVEY §7 stage 4): synthetic CIFAR-format data
+-> loader -> sampler -> cifar10_full net from the zoo -> train rounds ->
+test scoring.
+
+Ports the reference's native integration tests:
+- ``CifarSpec.scala:92``: a random-init net scores ~chance on the test set
+  (assert 0.7 <= acc*10 <= 1.3 over batches).
+- convergence: on separable synthetic data a few rounds must beat chance
+  decisively.
+- ``CifarFeaturizationSpec.scala``: forward + blob map exposes named
+  activations with the right shapes (conv1 = (B,32,32,32)).
+"""
+
+import numpy as np
+import pytest
+import jax
+
+from sparknet_tpu import models
+from sparknet_tpu.data import CifarLoader, DataTransformer, MinibatchSampler, Prefetcher
+from sparknet_tpu.solver import Solver
+
+
+@pytest.fixture(scope="module")
+def cifar_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cifar")
+    CifarLoader.write_synthetic(str(d), num_train=2000, num_test=400, seed=0)
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def loader(cifar_dir):
+    return CifarLoader(cifar_dir)
+
+
+def test_loader_shapes_and_mean(loader):
+    assert loader.train_images.shape == (2000, 3, 32, 32)
+    assert loader.test_images.shape == (400, 3, 32, 32)
+    assert loader.mean_image.shape == (3, 32, 32)
+    assert 0 < loader.mean_image.mean() < 255
+    x, y = loader.minibatches(100, train=True)
+    assert x.shape == (20, 100, 3, 32, 32)
+    assert y.shape == (20, 100)
+    # mean-subtracted data is roughly centered
+    assert abs(x.mean()) < 5.0
+
+
+def test_sampler_window_semantics(loader):
+    x, y = loader.minibatches(100, train=True)
+    s = MinibatchSampler({"data": x, "label": y}, num_sampled_batches=5, seed=1)
+    w = s.next_window()
+    assert w["data"].shape == (5, 100, 3, 32, 32)
+    # window is contiguous: find its offset and check alignment
+    idx = [np.where((x == w["data"][i]).all(axis=(1, 2, 3, 4)))[0][0] for i in range(5)]
+    assert idx == list(range(idx[0], idx[0] + 5))
+    full = s.full_pass()
+    assert full["data"].shape[0] == 20
+
+
+def test_random_init_scores_chance(loader):
+    solver = Solver(models.load_model_solver("cifar10_full"))
+    state = solver.init_state(seed=0)
+    xt, yt = loader.minibatches(100, train=False)
+    scores = solver.test_and_store_result(state, {"data": xt, "label": yt})
+    acc = scores["accuracy"] / len(xt)
+    # CifarSpec's chance-window assertion
+    assert 0.7 <= acc * 10 <= 1.3
+
+
+def test_trains_above_chance_and_features(loader):
+    solver = Solver(models.load_model_solver("cifar10_full"))
+    state = solver.init_state(seed=0)
+    x, y = loader.minibatches(100, train=True)
+    sampler = MinibatchSampler({"data": x, "label": y}, num_sampled_batches=10)
+    for _ in range(6):  # 6 rounds x tau=10
+        state, losses = solver.step(state, sampler.next_window())
+    assert solver.smoothed_loss < 2.25  # moving off chance (ln10=2.303)
+    xt, yt = loader.minibatches(100, train=False)
+    scores = solver.test_and_store_result(state, {"data": xt, "label": yt})
+    acc = scores["accuracy"] / len(xt)
+    assert acc > 0.2  # decisively above 10% chance on separable data
+
+    # featurization path (forward + getData analog)
+    blobs = solver.net.forward(
+        state.params, state.stats, {"data": x[0], "label": y[0]}
+    )
+    assert blobs["conv1"].shape == (100, 32, 32, 32)
+    assert blobs["ip1"].shape == (100, 10)
+
+
+def test_transformer_crop_mirror_mean(loader):
+    from sparknet_tpu.config.schema import TransformationParameter
+
+    p = TransformationParameter(crop_size=28, mirror=True, mean_file="x")
+    t = DataTransformer(p, phase="TRAIN", mean_image=loader.mean_image, seed=0)
+    out = t(loader.train_images[:16])
+    assert out.shape == (16, 3, 28, 28)
+    tc = DataTransformer(
+        TransformationParameter(crop_size=28, mean_file="x"),
+        phase="TEST",
+        mean_image=loader.mean_image,
+    )
+    out_a = tc(loader.train_images[:4])
+    out_b = tc(loader.train_images[:4])
+    np.testing.assert_array_equal(out_a, out_b)  # deterministic center crop
+    # center crop content matches manual slice minus cropped mean
+    manual = (
+        loader.train_images[:4, :, 2:30, 2:30].astype(np.float32)
+        - loader.mean_image[:, 2:30, 2:30]
+    )
+    np.testing.assert_allclose(out_a, manual)
+
+
+def test_prefetcher_pipeline(loader):
+    x, y = loader.minibatches(100, train=True)
+    sampler = MinibatchSampler({"data": x, "label": y}, num_sampled_batches=2)
+    count = 0
+
+    def produce():
+        nonlocal count
+        count += 1
+        if count > 4:
+            return None
+        return sampler.next_window()
+
+    pf = Prefetcher(produce, depth=2)
+    seen = list(pf)
+    assert len(seen) == 4
+    assert seen[0]["data"].shape == (2, 100, 3, 32, 32)
+    # items are device arrays ready for the jitted step
+    assert isinstance(seen[0]["data"], jax.Array)
+    pf.stop()
+
+
+def test_prefetcher_propagates_errors():
+    def produce():
+        raise RuntimeError("boom in producer")
+
+    pf = Prefetcher(produce, depth=1, device_put=False)
+    with pytest.raises(RuntimeError, match="boom in producer"):
+        next(pf)
+    pf.stop()
