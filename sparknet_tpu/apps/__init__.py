@@ -1,0 +1,1 @@
+"""Training-driver apps — the L1 layer (reference: ``src/main/scala/apps``)."""

@@ -1,0 +1,73 @@
+"""FeaturizerApp — batch feature extraction.
+
+Reference: ``src/main/scala/apps/FeaturizerApp.scala:88-103`` — broadcast
+weights once, forward each minibatch, pull a named blob back as an NDArray.
+Here ``JaxNet.forward`` returns every blob, so the tap is a dict lookup.
+
+Run:
+    python -m sparknet_tpu.apps.featurizer_app --model=NAME --blob=ip1 \
+        [--weights=F.caffemodel] [--batches=4] [--out=features.npz]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="cifar10_full")
+    parser.add_argument("--blob", default="ip1")
+    parser.add_argument("--weights", default=None)
+    parser.add_argument("--batches", type=int, default=4)
+    parser.add_argument("--out", default=None)
+    args = parser.parse_args(argv)
+
+    import jax
+
+    from sparknet_tpu import models
+    from sparknet_tpu.io import caffemodel
+    from sparknet_tpu.net import JaxNet
+
+    netp = (
+        models.load_model(args.model)
+        if not args.model.endswith(".prototxt")
+        else __import__("sparknet_tpu.config", fromlist=["load_net_prototxt"])
+        .load_net_prototxt(args.model)
+    )
+    net = JaxNet(netp, phase="TEST")
+    params, stats = net.init(0)
+    if args.weights:
+        loaded = caffemodel.load_weights(args.weights)
+        params, stats = caffemodel.apply_blobs(net, params, stats, loaded)
+
+    rng = np.random.RandomState(0)
+    feats = []
+    fwd = jax.jit(net.forward)
+    for i in range(args.batches):
+        batch = {}
+        for blob in net.feed_blobs:
+            shape = net.blob_shapes[blob]
+            batch[blob] = (
+                rng.randint(0, 10, shape).astype(np.float32)
+                if "label" in blob
+                else rng.randn(*shape).astype(np.float32)
+            )
+        blobs = fwd(params, stats, batch)
+        if args.blob not in blobs:
+            raise SystemExit(
+                f"blob {args.blob!r} not in net; have {sorted(blobs)}"
+            )
+        feats.append(np.asarray(blobs[args.blob]))
+    features = np.stack(feats)
+    print(f"extracted {args.blob}: {features.shape}")
+    if args.out:
+        np.savez(args.out, features=features)
+        print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

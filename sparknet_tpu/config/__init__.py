@@ -31,7 +31,25 @@ def load_net_prototxt(path: str, permissive: bool = False) -> NetParameter:
 
 
 def load_solver_prototxt(path: str, permissive: bool = False) -> SolverParameter:
-    return parse_file(path, SolverParameter, permissive=permissive)
+    solver = parse_file(path, SolverParameter, permissive=permissive)
+    # net paths resolve like the reference's (relative to cwd), with a
+    # fallback to the solver file's own directory so zoo configs work from
+    # any cwd
+    import os
+
+    base = os.path.dirname(os.path.abspath(path))
+
+    def resolve(p):
+        if p and not os.path.isabs(p) and not os.path.exists(p):
+            cand = os.path.join(base, p)
+            if os.path.exists(cand):
+                return cand
+        return p
+
+    solver.net = resolve(solver.net)
+    solver.train_net = resolve(solver.train_net)
+    solver.test_net = [resolve(p) for p in solver.test_net]
+    return solver
 
 
 def load_solver_prototxt_with_net(

@@ -1,0 +1,13 @@
+"""Binary I/O: weight files, mean images, checkpoints."""
+
+from sparknet_tpu.io.caffemodel import (  # noqa: F401
+    load_mean_image,
+    load_weights,
+    save_mean_image,
+    save_weights,
+)
+from sparknet_tpu.io.checkpoint import (  # noqa: F401
+    load_weights_into_state,
+    restore,
+    snapshot,
+)

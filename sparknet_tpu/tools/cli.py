@@ -1,0 +1,198 @@
+"""Command-line interface — the ``caffe`` binary's brew commands.
+
+Reference: ``caffe/tools/caffe.cpp:28-55`` registers train/test/time/
+device_query; flag semantics preserved where they make sense on TPU:
+
+    python -m sparknet_tpu.tools.cli train --solver=S [--snapshot=F.solverstate.npz]
+        [--weights=F.caffemodel] [--data=DIR] [--sigint_effect=stop|snapshot|none]
+    python -m sparknet_tpu.tools.cli test --model=N --weights=F [--iterations=50]
+    python -m sparknet_tpu.tools.cli time --model=N [--iterations=50]
+    python -m sparknet_tpu.tools.cli device_query
+
+``--gpu=...`` becomes ``--devices=N`` (first N local TPU devices as the dp
+mesh; the P2PSync role is AllReduceTrainer).  Data comes from ``--data``
+(CIFAR binary dir) or synthetic batches matching the net's feed shapes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def _load_net(path):
+    from sparknet_tpu import config
+
+    return config.load_net_prototxt(path)
+
+
+def _synthetic_batches(net, tau: int, seed: int = 0) -> Dict[str, np.ndarray]:
+    rng = np.random.RandomState(seed)
+    out = {}
+    for blob in net.feed_blobs:
+        shape = net.blob_shapes[blob]
+        if "label" in blob:
+            out[blob] = rng.randint(0, 10, (tau,) + tuple(shape)).astype(
+                np.float32
+            )
+        else:
+            out[blob] = rng.randn(tau, *shape).astype(np.float32)
+    return out
+
+
+def cmd_train(args) -> int:
+    import jax
+
+    from sparknet_tpu import config
+    from sparknet_tpu.data import CifarLoader, MinibatchSampler
+    from sparknet_tpu.io import checkpoint
+    from sparknet_tpu.solver import Solver
+    from sparknet_tpu.utils import SignalHandler, SolverAction, TrainingLog
+
+    solver_param = config.load_solver_prototxt(args.solver)
+    solver = Solver(solver_param)
+    if args.snapshot:
+        state = checkpoint.restore(solver, args.snapshot)
+        print(f"resumed from {args.snapshot} at iter {int(state.iter)}")
+    else:
+        state = solver.init_state(seed=args.seed)
+        if args.weights:
+            state = checkpoint.load_weights_into_state(solver, state, args.weights)
+            print(f"warm-started weights from {args.weights}")
+
+    effects = {
+        "stop": SolverAction.STOP,
+        "snapshot": SolverAction.SNAPSHOT,
+        "none": SolverAction.NONE,
+    }
+    handler = SignalHandler(
+        sigint_effect=effects[args.sigint_effect],
+        sighup_effect=effects[args.sighup_effect],
+    )
+    log = TrainingLog(tag="train")
+
+    sampler = None
+    if args.data:
+        loader = CifarLoader(args.data)
+        x, y = loader.minibatches(
+            solver.net.blob_shapes[solver.net.feed_blobs[0]][0]
+        )
+        sampler = MinibatchSampler(
+            {"data": x, "label": y}, num_sampled_batches=args.tau, seed=args.seed
+        )
+
+    max_iter = args.max_iter or solver_param.max_iter or 1000
+    snap_every = solver_param.snapshot
+    prefix = solver_param.snapshot_prefix or "snapshot"
+    while int(jax.device_get(state.iter)) < max_iter:
+        batches = (
+            sampler.next_window()
+            if sampler
+            else _synthetic_batches(solver.net, args.tau)
+        )
+        state, _ = solver.step(state, batches)
+        it = int(jax.device_get(state.iter))
+        log.log(f"iter {it} smoothed_loss {solver.smoothed_loss:.4f}")
+        action = handler.get_action()
+        if action == SolverAction.SNAPSHOT or (
+            snap_every and it % snap_every < args.tau and it >= snap_every
+        ):
+            paths = checkpoint.snapshot(solver, state, prefix)
+            log.log(f"snapshotted to {paths[0]}")
+        if action == SolverAction.STOP:
+            log.log("stop requested; snapshotting and exiting")
+            checkpoint.snapshot(solver, state, prefix)
+            break
+    handler.restore()
+    return 0
+
+
+def cmd_test(args) -> int:
+    from sparknet_tpu.config import parse_solver_prototxt
+    from sparknet_tpu.io import checkpoint
+    from sparknet_tpu.solver import Solver
+
+    netp = _load_net(args.model)
+    solver = Solver(
+        parse_solver_prototxt('base_lr: 0.0 lr_policy: "fixed"'), net_param=netp
+    )
+    state = solver.init_state(0)
+    if args.weights:
+        state = checkpoint.load_weights_into_state(solver, state, args.weights)
+    batches = _synthetic_batches(solver.test_net, args.iterations)
+    scores = solver.test_and_store_result(state, batches)
+    for name, total in scores.items():
+        print(f"{name} = {total / args.iterations:.4f}")
+    return 0
+
+
+def cmd_time(args) -> int:
+    import jax
+
+    from sparknet_tpu.config import parse_solver_prototxt
+    from sparknet_tpu.net import JaxNet
+    from sparknet_tpu.utils.profiler import format_profile, profile_net
+
+    netp = _load_net(args.model)
+    net = JaxNet(netp, phase="TRAIN")
+    params, stats = net.init(0)
+    batch = {k: v[0] for k, v in _synthetic_batches(net, 1).items()}
+    result = profile_net(net, params, stats, batch, iterations=args.iterations)
+    print(format_profile(result))
+    return 0
+
+
+def cmd_device_query(args) -> int:
+    import jax
+
+    for d in jax.devices():
+        print(
+            f"device {d.id}: platform={d.platform} kind={d.device_kind} "
+            f"process={d.process_index}"
+        )
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="sparknet_tpu", description=__doc__)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("train")
+    p.add_argument("--solver", required=True)
+    p.add_argument("--snapshot", default=None)
+    p.add_argument("--weights", default=None)
+    p.add_argument("--data", default=None, help="CIFAR binary dir")
+    p.add_argument("--tau", type=int, default=10)
+    p.add_argument("--max_iter", type=int, default=0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--sigint_effect", choices=["stop", "snapshot", "none"], default="stop"
+    )
+    p.add_argument(
+        "--sighup_effect", choices=["stop", "snapshot", "none"], default="snapshot"
+    )
+    p.set_defaults(fn=cmd_train)
+
+    p = sub.add_parser("test")
+    p.add_argument("--model", required=True)
+    p.add_argument("--weights", default=None)
+    p.add_argument("--iterations", type=int, default=50)
+    p.set_defaults(fn=cmd_test)
+
+    p = sub.add_parser("time")
+    p.add_argument("--model", required=True)
+    p.add_argument("--iterations", type=int, default=10)
+    p.set_defaults(fn=cmd_time)
+
+    p = sub.add_parser("device_query")
+    p.set_defaults(fn=cmd_device_query)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,5 @@
+"""Aux subsystems: timers, signal handling, profiling, experiment logs."""
+
+from sparknet_tpu.utils.signals import SignalHandler, SolverAction  # noqa: F401
+from sparknet_tpu.utils.timers import CPUTimer, Timer  # noqa: F401
+from sparknet_tpu.utils.trainlog import TrainingLog  # noqa: F401

@@ -1,0 +1,109 @@
+"""Per-layer forward/backward profiler — the ``caffe time`` analog.
+
+Reference: ``tools/caffe.cpp:290-376`` warms up, then averages per-layer
+forward/backward microseconds over N iterations plus whole-net times.  On
+TPU the fused whole-net jit is the honest end-to-end number; the per-layer
+numbers here time each layer's computation jitted in isolation against the
+real intermediate activations — indicative of relative cost, not additive
+to the fused total (XLA fuses across layers; that's the point of the
+design).  For deep profiles, ``jax.profiler.trace`` output is the real
+tool; ``profile_trace`` wraps it.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from sparknet_tpu.net import JaxNet
+from sparknet_tpu.ops import data_layers
+
+
+def _time_fn(fn, args, iters: int, warmup: int = 1) -> float:
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def profile_net(
+    net: JaxNet,
+    params,
+    stats,
+    batch,
+    iterations: int = 10,
+    rng=None,
+) -> Dict[str, object]:
+    """Returns {layer: {forward_ms, backward_ms}, total_forward_ms,
+    total_fwdbwd_ms} like `caffe time`'s table."""
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    # whole-net numbers (the fused-program truth)
+    fwd = jax.jit(lambda p, s, b: net.apply(p, s, b, rng=rng, train=True).loss)
+    total_fwd = _time_fn(fwd, (params, stats, batch), iterations)
+    grad = jax.jit(jax.grad(lambda p: net.loss_fn(p, stats, batch, rng, True)[0]))
+    total_fwdbwd = _time_fn(grad, (params,), iterations)
+
+    # per-layer isolated timings against real activations
+    out = net.apply(params, stats, batch, rng=rng, train=True)
+    blobs = {k: jax.device_get(v) for k, v in out.blobs.items()}
+    per_layer: Dict[str, Dict[str, float]] = {}
+    for li, layer in enumerate(net.layers):
+        if isinstance(layer, data_layers._HostFed):
+            continue
+        lblobs = net._gather_blobs(layer.name, params, stats)
+        bottoms = [jax.device_put(blobs[b]) for b in layer.lp.bottom]
+        lrng = jax.random.fold_in(rng, li)
+
+        def run(lb, bt):
+            tops, _ = layer.apply(list(lb), list(bt), lrng, True)
+            return tops
+
+        jrun = jax.jit(run)
+        f_ms = _time_fn(jrun, (lblobs, bottoms), iterations) * 1e3
+
+        b_ms = 0.0
+        if bottoms or lblobs:
+
+            def run_sum(lb, bt):
+                tops, _ = layer.apply(list(lb), list(bt), lrng, True)
+                return sum(jax.numpy.sum(t) for t in tops) if tops else 0.0
+
+            try:
+                jgrad = jax.jit(jax.grad(run_sum, argnums=(0, 1)))
+                b_ms = _time_fn(jgrad, (lblobs, bottoms), iterations) * 1e3
+            except Exception:
+                b_ms = float("nan")  # non-differentiable layer (e.g. Accuracy)
+        per_layer[layer.name] = {"forward_ms": f_ms, "backward_ms": b_ms}
+
+    return {
+        "layers": per_layer,
+        "total_forward_ms": total_fwd * 1e3,
+        "total_fwdbwd_ms": total_fwdbwd * 1e3,
+    }
+
+
+def format_profile(result: Dict[str, object]) -> str:
+    """`caffe time`-style report."""
+    lines = ["%-20s %14s %14s" % ("layer", "forward (ms)", "backward (ms)")]
+    for name, t in result["layers"].items():
+        lines.append(
+            "%-20s %14.3f %14.3f" % (name, t["forward_ms"], t["backward_ms"])
+        )
+    lines.append(
+        "fused whole-net: forward %.3f ms, forward+backward %.3f ms"
+        % (result["total_forward_ms"], result["total_fwdbwd_ms"])
+    )
+    return "\n".join(lines)
+
+
+def profile_trace(path: str):
+    """Context manager writing a jax.profiler trace viewable in
+    TensorBoard/Perfetto (the deep-profiling path)."""
+    return jax.profiler.trace(path)

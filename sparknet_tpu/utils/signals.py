@@ -1,0 +1,57 @@
+"""Signal-driven stop/snapshot.
+
+Reference: ``caffe/src/caffe/util/signal_handler.cpp:9-60`` + the solver's
+per-iteration action poll (``solver.cpp:267-280``) and the CLI flags
+``--sigint_effect/--sighup_effect`` (tools/caffe.cpp:43-46).  SIGINT
+defaults to STOP, SIGHUP to SNAPSHOT; handlers only set flags — the driver
+polls between rounds (never mid-jit).
+"""
+
+from __future__ import annotations
+
+import enum
+import signal
+from typing import Optional
+
+
+class SolverAction(enum.Enum):
+    NONE = 0
+    STOP = 1
+    SNAPSHOT = 2
+
+
+class SignalHandler:
+    def __init__(
+        self,
+        sigint_effect: SolverAction = SolverAction.STOP,
+        sighup_effect: SolverAction = SolverAction.SNAPSHOT,
+    ):
+        self._effects = {}
+        self._flags = {SolverAction.STOP: False, SolverAction.SNAPSHOT: False}
+        self._prev = {}
+        for sig, effect in (
+            (signal.SIGINT, sigint_effect),
+            (signal.SIGHUP, sighup_effect),
+        ):
+            if effect != SolverAction.NONE:
+                self._effects[sig] = effect
+                self._prev[sig] = signal.signal(sig, self._handle)
+
+    def _handle(self, signum, frame):
+        effect = self._effects.get(signum)
+        if effect is not None:
+            self._flags[effect] = True
+
+    def get_action(self) -> SolverAction:
+        """Poll-and-clear, highest priority first (STOP beats SNAPSHOT)."""
+        if self._flags[SolverAction.STOP]:
+            self._flags[SolverAction.STOP] = False
+            return SolverAction.STOP
+        if self._flags[SolverAction.SNAPSHOT]:
+            self._flags[SolverAction.SNAPSHOT] = False
+            return SolverAction.SNAPSHOT
+        return SolverAction.NONE
+
+    def restore(self):
+        for sig, prev in self._prev.items():
+            signal.signal(sig, prev)

@@ -1,0 +1,53 @@
+"""Timers (reference: ``include/caffe/util/benchmark.hpp:10-46``).
+
+``Timer`` syncs the device (block_until_ready on a token) the way the
+reference's cudaEvent timer syncs the stream; ``CPUTimer`` is wall clock.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+
+
+class CPUTimer:
+    def __init__(self):
+        self._start: Optional[float] = None
+        self._elapsed = 0.0
+        self.has_run_at_least_once = False
+
+    def start(self):
+        self._start = time.perf_counter()
+        return self
+
+    def stop(self):
+        if self._start is not None:
+            self._elapsed = time.perf_counter() - self._start
+            self.has_run_at_least_once = True
+            self._start = None
+        return self
+
+    def milli_seconds(self) -> float:
+        return self._elapsed * 1e3
+
+    def micro_seconds(self) -> float:
+        return self._elapsed * 1e6
+
+    def seconds(self) -> float:
+        return self._elapsed
+
+
+class Timer(CPUTimer):
+    """Device-synchronized timer: stop() waits for the given arrays (or
+    all pending work) before reading the clock."""
+
+    def __init__(self, sync_on=None):
+        super().__init__()
+        self._sync_on = sync_on
+
+    def stop(self):
+        if self._sync_on is not None:
+            jax.block_until_ready(self._sync_on)
+        return super().stop()

@@ -1,0 +1,34 @@
+"""Timestamped phase log — the driver's experiment record.
+
+Reference: ``CifarApp.scala:36-46`` writes elapsed-seconds structured lines
+per phase per iteration to ``training_log_<timestamp>.txt``; that file is
+the primary experiment record (SURVEY §5).  Format preserved.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional, TextIO
+
+
+class TrainingLog:
+    def __init__(self, directory: str = ".", tag: str = "", echo: bool = True):
+        os.makedirs(directory, exist_ok=True)
+        ts = int(time.time() * 1000)
+        suffix = f"_{tag}" if tag else ""
+        self.path = os.path.join(directory, f"training_log_{ts}{suffix}.txt")
+        self._f: TextIO = open(self.path, "a")
+        self._t0 = time.time()
+        self._echo = echo
+
+    def log(self, message: str):
+        elapsed = time.time() - self._t0
+        line = f"{elapsed:.3f}: {message}"
+        self._f.write(line + "\n")
+        self._f.flush()
+        if self._echo:
+            print(line)
+
+    def close(self):
+        self._f.close()

@@ -1,0 +1,185 @@
+"""Engine-parity subsystem tests: binary weight I/O, checkpoint/resume,
+signals, profiler, training log.
+
+The key invariant (reference: ``test_gradient_based_solver.cpp:179-211``
+snapshot tests): training tau, snapshotting, restoring, then training tau
+more must equal training 2*tau straight through — including solver history.
+"""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+import jax
+
+from sparknet_tpu import config
+from sparknet_tpu.io import caffemodel, checkpoint, wire
+from sparknet_tpu.solver import Solver
+
+NET = """
+name: "ckpt_net"
+layer { name: "data" type: "HostData" top: "x" top: "label"
+  java_data_param { shape { dim: 8 dim: 4 } shape { dim: 8 } } }
+layer { name: "ip1" type: "InnerProduct" bottom: "x" top: "h"
+  inner_product_param { num_output: 8 weight_filler { type: "xavier" } } }
+layer { name: "bn" type: "BatchNorm" bottom: "h" top: "hb" }
+layer { name: "ip2" type: "InnerProduct" bottom: "hb" top: "logits"
+  inner_product_param { num_output: 3 weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "logits" bottom: "label" top: "loss" }
+"""
+
+
+def _solver(type_=""):
+    sp = config.parse_solver_prototxt(
+        f'base_lr: 0.05 lr_policy: "fixed" momentum: 0.9 {type_}'
+    )
+    return Solver(sp, net_param=config.parse_net_prototxt(NET))
+
+
+def _batches(tau, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "x": rng.randn(tau, 8, 4).astype(np.float32),
+        "label": rng.randint(0, 3, (tau, 8)).astype(np.float32),
+    }
+
+
+def test_wire_varint_roundtrip():
+    for v in [0, 1, 127, 128, 300, 2**32, 2**63 - 1]:
+        enc = wire.encode_varint(v)
+        dec, pos = wire.decode_varint(memoryview(enc), 0)
+        assert dec == v and pos == len(enc)
+
+
+def test_blob_roundtrip():
+    arr = np.random.RandomState(0).randn(4, 3, 2).astype(np.float32)
+    dec = caffemodel.decode_blob(caffemodel.encode_blob(arr))
+    np.testing.assert_array_equal(dec, arr)
+
+
+def test_caffemodel_roundtrip(tmp_path):
+    blobs = {
+        "conv1": [
+            np.random.RandomState(1).randn(8, 3, 5, 5).astype(np.float32),
+            np.zeros(8, np.float32),
+        ],
+        "fc": [np.random.RandomState(2).randn(10, 128).astype(np.float32)],
+    }
+    path = str(tmp_path / "w.caffemodel")
+    caffemodel.save_weights(blobs, path)
+    loaded = caffemodel.load_weights(path)
+    assert set(loaded) == {"conv1", "fc"}
+    for k in blobs:
+        for a, b in zip(blobs[k], loaded[k]):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_mean_image_roundtrip(tmp_path):
+    mean = np.random.RandomState(0).rand(3, 32, 32).astype(np.float32)
+    path = str(tmp_path / "mean.binaryproto")
+    caffemodel.save_mean_image(mean, path)
+    np.testing.assert_allclose(caffemodel.load_mean_image(path), mean)
+
+
+def test_snapshot_restore_continues_exactly(tmp_path):
+    prefix = str(tmp_path / "snap")
+    batches = _batches(5)
+    # straight-through run: 10 iters
+    s_ref = _solver()
+    st_ref = s_ref.init_state(0)
+    st_ref, _ = s_ref.step(st_ref, _batches(5, 0))
+    st_ref, _ = s_ref.step(st_ref, _batches(5, 1))
+    final_ref = np.asarray(st_ref.params["ip1"][0])
+
+    # snapshot mid-way, restore in a FRESH solver, continue
+    s_a = _solver()
+    st_a = s_a.init_state(0)
+    st_a, _ = s_a.step(st_a, _batches(5, 0))
+    model_path, state_path = checkpoint.snapshot(s_a, st_a, prefix)
+    assert os.path.exists(model_path) and os.path.exists(state_path)
+
+    s_b = _solver()
+    st_b = checkpoint.restore(s_b, state_path)
+    assert int(st_b.iter) == 5
+    st_b, _ = s_b.step(st_b, _batches(5, 1))
+    np.testing.assert_allclose(
+        np.asarray(st_b.params["ip1"][0]), final_ref, rtol=1e-6
+    )
+    # BN stats restored too
+    np.testing.assert_allclose(
+        np.asarray(st_b.stats["bn"][0]),
+        np.asarray(st_ref.stats["bn"][0]),
+        rtol=1e-6,
+    )
+
+
+def test_weights_warm_start(tmp_path):
+    s = _solver()
+    st = s.init_state(0)
+    st, _ = s.step(st, _batches(3))
+    blobs = caffemodel.net_blobs(s.net, st.params, st.stats)
+    path = str(tmp_path / "warm.caffemodel")
+    caffemodel.save_weights(blobs, path)
+
+    s2 = _solver()
+    st2 = s2.init_state(seed=42)  # different init
+    st2 = checkpoint.load_weights_into_state(s2, st2, path)
+    np.testing.assert_allclose(
+        np.asarray(st2.params["ip1"][0]), np.asarray(st.params["ip1"][0])
+    )
+    assert int(st2.iter) == 0  # iter untouched by warm start
+
+
+def test_apply_blobs_shape_mismatch_raises():
+    s = _solver()
+    st = s.init_state(0)
+    bad = {"ip1": [np.zeros((7, 7), np.float32), np.zeros(8, np.float32)]}
+    with pytest.raises(ValueError, match="shape"):
+        caffemodel.apply_blobs(s.net, st.params, st.stats, bad)
+    # unknown layer names are skipped silently (CopyTrainedLayersFrom)
+    p, _ = caffemodel.apply_blobs(
+        s.net, st.params, st.stats, {"nonexistent": [np.zeros(3)]}
+    )
+
+
+def test_signal_handler():
+    from sparknet_tpu.utils import SignalHandler, SolverAction
+
+    h = SignalHandler()
+    assert h.get_action() == SolverAction.NONE
+    os.kill(os.getpid(), signal.SIGHUP)
+    assert h.get_action() == SolverAction.SNAPSHOT
+    assert h.get_action() == SolverAction.NONE  # cleared after poll
+    os.kill(os.getpid(), signal.SIGINT)
+    os.kill(os.getpid(), signal.SIGHUP)
+    assert h.get_action() == SolverAction.STOP  # STOP wins
+    assert h.get_action() == SolverAction.SNAPSHOT
+    h.restore()
+
+
+def test_profiler_runs():
+    from sparknet_tpu.net import JaxNet
+    from sparknet_tpu.utils.profiler import format_profile, profile_net
+
+    net = JaxNet(config.parse_net_prototxt(NET), phase="TRAIN")
+    params, stats = net.init(0)
+    batch = {k: v[0] for k, v in _batches(1).items()}
+    batch = {"x": batch["x"], "label": batch["label"]}
+    result = profile_net(net, params, stats, batch, iterations=2)
+    assert set(result["layers"]) == {"ip1", "bn", "ip2", "loss"}
+    assert result["total_fwdbwd_ms"] > 0
+    report = format_profile(result)
+    assert "ip1" in report and "fused whole-net" in report
+
+
+def test_training_log(tmp_path):
+    from sparknet_tpu.utils import TrainingLog
+
+    log = TrainingLog(directory=str(tmp_path), tag="t", echo=False)
+    log.log("hello phase")
+    log.close()
+    content = open(log.path).read()
+    assert "hello phase" in content
+    # "elapsed: message" format like CifarApp.scala:44
+    assert content.split(":")[0].replace(".", "").isdigit()
