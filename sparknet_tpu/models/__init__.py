@@ -40,20 +40,35 @@ _SOLVER_FILES = {
 
 
 def available_models() -> List[str]:
-    return sorted(
+    from sparknet_tpu.models.builders import BUILDERS
+
+    files = {
         name
         for name, f in _NET_FILES.items()
         if os.path.exists(os.path.join(ZOO_DIR, f))
-    )
+    }
+    return sorted(files | set(BUILDERS))
 
 
-def load_model(name: str) -> NetParameter:
+def load_model(name: str, **builder_kwargs) -> NetParameter:
+    """Load a zoo model by name: prototxt file if present, else the
+    programmatic builder (builders accept batch/image/classes overrides)."""
+    from sparknet_tpu.models.builders import BUILDERS
+
+    path = os.path.join(ZOO_DIR, _NET_FILES.get(name, f"{name}.prototxt"))
+    if os.path.exists(path) and not builder_kwargs:
+        return load_net_prototxt(path)
+    if name in BUILDERS:
+        return BUILDERS[name](**builder_kwargs)
     if name not in _NET_FILES:
-        raise KeyError(f"unknown model {name!r}; have {sorted(_NET_FILES)}")
-    path = os.path.join(ZOO_DIR, _NET_FILES[name])
-    if not os.path.exists(path):
-        raise FileNotFoundError(f"model config not in zoo yet: {path}")
-    return load_net_prototxt(path)
+        raise KeyError(f"unknown model {name!r}; have {available_models()}")
+    if builder_kwargs and os.path.exists(path):
+        raise ValueError(
+            f"model {name!r} is prototxt-backed; overrides like "
+            f"{sorted(builder_kwargs)} only apply to builder models — edit "
+            f"the config or use config.replace_data_layers for batch shapes"
+        )
+    raise FileNotFoundError(f"model config not in zoo yet: {path}")
 
 
 def load_model_solver(name: str) -> SolverParameter:

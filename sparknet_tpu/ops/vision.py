@@ -56,6 +56,14 @@ class _ConvBase(Layer):
             (b.lr_mult if b else 1.0, b.decay_mult if b else 1.0),
         )
 
+    def _checked_out_hw(self, oh: int, ow: int, h: int, w: int):
+        if oh <= 0 or ow <= 0:
+            raise ValueError(
+                f"layer {self.name!r}: output {oh}x{ow} non-positive for "
+                f"input {h}x{w}"
+            )
+        return oh, ow
+
 
 @register
 class Convolution(_ConvBase):
@@ -101,6 +109,7 @@ class Convolution(_ConvBase):
         n, _, h, w = bottom_shapes[0]
         oh = (h + 2 * ph - ((kh - 1) * dh + 1)) // sh + 1
         ow = (w + 2 * pw - ((kw - 1) * dw + 1)) // sw + 1
+        oh, ow = self._checked_out_hw(oh, ow, h, w)
         return [(n, cp.num_output, oh, ow)]
 
     def apply(self, blobs, bottoms, rng, train):
@@ -154,6 +163,7 @@ class Deconvolution(_ConvBase):
         n, _, h, w = bottom_shapes[0]
         oh = sh * (h - 1) + (kh - 1) * dh + 1 - 2 * ph
         ow = sw * (w - 1) + (kw - 1) * dw + 1 - 2 * pw
+        oh, ow = self._checked_out_hw(oh, ow, h, w)
         return [(n, cp.num_output, oh, ow)]
 
     def apply(self, blobs, bottoms, rng, train):
@@ -208,6 +218,11 @@ def _pool_geometry(pp, h, w):
             oh -= 1
         if (ow - 1) * sw >= w + pw:
             ow -= 1
+    if oh <= 0 or ow <= 0:
+        raise ValueError(
+            f"pooling kernel {kh}x{kw} stride {sh}x{sw} pad {ph}x{pw} "
+            f"yields non-positive output for input {h}x{w}"
+        )
     return (kh, kw), (sh, sw), (ph, pw), (oh, ow)
 
 
@@ -373,6 +388,7 @@ class Im2col(_ConvBase):
         n, c, h, w = bottom_shapes[0]
         oh = (h + 2 * ph - ((kh - 1) * dh + 1)) // sh + 1
         ow = (w + 2 * pw - ((kw - 1) * dw + 1)) // sw + 1
+        oh, ow = self._checked_out_hw(oh, ow, h, w)
         return [(n, c * kh * kw, oh, ow)]
 
     def apply(self, blobs, bottoms, rng, train):

@@ -1,0 +1,139 @@
+"""Model-zoo structural tests: shapes of the reference architectures.
+
+Shape goldens come from the published architectures (e.g. AlexNet conv1
+(N,96,55,55), GoogLeNet inception outputs 256/480/512/.../1024, ResNet-50
+stage channel plan 256/512/1024/2048) — building them exercises the DAG
+machinery (concat fan-in, aux heads, residual eltwise, BN+Scale chains).
+"""
+
+import numpy as np
+import pytest
+import jax
+
+from sparknet_tpu import models
+from sparknet_tpu.net import JaxNet
+
+
+def test_available_models():
+    names = models.available_models()
+    for required in (
+        "alexnet",
+        "caffenet",
+        "cifar10_full",
+        "googlenet",
+        "lenet",
+        "resnet50",
+    ):
+        assert required in names
+
+
+def test_alexnet_shapes():
+    net = JaxNet(models.load_model("alexnet"), phase="TRAIN")
+    s = net.blob_shapes
+    assert s["conv1"] == (256, 96, 55, 55)
+    assert s["pool1"] == (256, 96, 27, 27)
+    assert s["conv2"] == (256, 256, 27, 27)
+    assert s["pool2"] == (256, 256, 13, 13)
+    assert s["conv5"] == (256, 256, 13, 13)
+    assert s["pool5"] == (256, 256, 6, 6)
+    assert s["fc6"] == (256, 4096)
+    assert s["fc8"] == (256, 1000)
+
+
+def test_caffenet_shapes():
+    net = JaxNet(models.load_model("caffenet", batch=8), phase="TRAIN")
+    s = net.blob_shapes
+    assert s["conv1"] == (8, 96, 55, 55)
+    assert s["norm1"] == (8, 96, 27, 27)  # pool-before-norm ordering
+    assert s["fc8"] == (8, 1000)
+
+
+def test_googlenet_shapes_and_aux_heads():
+    netp = models.load_model("googlenet", batch=4)
+    net = JaxNet(netp, phase="TRAIN")
+    s = net.blob_shapes
+    assert s["conv1/7x7_s2"] == (4, 64, 112, 112)
+    assert s["inception_3a/output"] == (4, 256, 28, 28)
+    assert s["inception_3b/output"] == (4, 480, 28, 28)
+    assert s["inception_4a/output"] == (4, 512, 14, 14)
+    assert s["inception_4e/output"] == (4, 832, 14, 14)
+    assert s["inception_5b/output"] == (4, 1024, 7, 7)
+    assert s["pool5/7x7_s1"] == (4, 1024, 1, 1)
+    assert s["loss1/ave_pool"] == (4, 512, 4, 4)
+    # three losses in TRAIN, aux weighted 0.3
+    losses = [l for l in net.layers if l.TYPE == "SoftmaxWithLoss"]
+    assert len(losses) == 3
+    weights = sorted(sum((net._loss_weights[l.name] for l in losses), []))
+    assert weights == [0.3, 0.3, 1.0]
+    # aux heads present in TEST too (reference has no phase rules on them);
+    # top-5 accuracy present
+    tnet = JaxNet(netp, phase="TEST")
+    assert "loss1/loss" in tnet.layer_names
+    assert "loss3/top-5" in tnet.layer_names
+
+
+def test_resnet50_shapes_and_param_count():
+    netp = models.load_model("resnet50", batch=2)
+    net = JaxNet(netp, phase="TRAIN")
+    s = net.blob_shapes
+    assert s["conv1"] == (2, 64, 112, 112)
+    assert s["res2c"] == (2, 256, 56, 56)
+    assert s["res3d"] == (2, 512, 28, 28)
+    assert s["res4f"] == (2, 1024, 14, 14)
+    assert s["res5c"] == (2, 2048, 7, 7)
+    assert s["pool5"] == (2, 2048, 1, 1)
+    params, stats = net.init(0)
+    n_learnable = sum(
+        int(np.prod(b.shape)) for bs in params.values() for b in bs
+    )
+    # ResNet-50 ~25.6M params (conv+fc+scale/bias)
+    assert 25_000_000 < n_learnable < 26_000_000
+    # BN stat blobs exist for every bn layer
+    assert len(stats) == 53  # 53 BatchNorm layers in ResNet-50
+
+
+def test_googlenet_trains_one_step_tiny():
+    # tiny spatial size to keep CPU time sane; exercises aux heads + concat
+    from sparknet_tpu import config
+    from sparknet_tpu.solver import Solver
+
+    netp = models.load_model("googlenet", batch=2, image=64, classes=8)
+    sp = config.parse_solver_prototxt('base_lr: 0.01 lr_policy: "fixed" momentum: 0.9')
+    solver = Solver(sp, net_param=netp)
+    st = solver.init_state(0)
+    rng = np.random.RandomState(0)
+    batch = {
+        "data": rng.randn(1, 2, 3, 64, 64).astype(np.float32),
+        "label": rng.randint(0, 8, (1, 2)).astype(np.float32),
+    }
+    st, losses = solver.step(st, batch)
+    assert np.isfinite(float(losses[0]))
+    # total loss includes aux heads: > single-head chance loss ln(8)
+    assert float(losses[0]) > np.log(8)
+
+
+def test_resnet50_trains_one_step_tiny():
+    from sparknet_tpu import config
+    from sparknet_tpu.solver import Solver
+
+    netp = models.load_model("resnet50", batch=2, image=64, classes=8)
+    sp = config.parse_solver_prototxt('base_lr: 0.01 lr_policy: "fixed" momentum: 0.9')
+    solver = Solver(sp, net_param=netp)
+    st = solver.init_state(0)
+    rng = np.random.RandomState(0)
+    batch = {
+        "data": rng.randn(1, 2, 3, 64, 64).astype(np.float32),
+        "label": rng.randint(0, 8, (1, 2)).astype(np.float32),
+    }
+    st0_bn = np.asarray(st.stats["bn_conv1"][2])
+    st, losses = solver.step(st, batch)
+    assert np.isfinite(float(losses[0]))
+    # BN moving stats updated through the scan
+    assert not np.allclose(np.asarray(st.stats["bn_conv1"][2]), st0_bn)
+
+
+def test_model_solvers_load():
+    for name in ("caffenet", "googlenet", "resnet50"):
+        sp = models.load_model_solver(name)
+        assert sp.net_param is not None
+        assert sp.base_lr > 0

@@ -221,3 +221,33 @@ def test_allreduce_with_tensor_parallel_axis():
     np.testing.assert_allclose(
         np.asarray(losses), np.asarray(rlosses), rtol=1e-4
     )
+
+
+def test_batchnorm_stats_averaged_across_workers():
+    # BN moving stats are net blobs in the reference, so the averaging round
+    # must average them like params (history stays local)
+    from sparknet_tpu.solver import Solver
+    net = """
+layer { name: "data" type: "HostData" top: "x" top: "label"
+  java_data_param { shape { dim: 8 dim: 4 dim: 2 dim: 2 } shape { dim: 8 } } }
+layer { name: "conv" type: "Convolution" bottom: "x" top: "c"
+  convolution_param { num_output: 4 kernel_size: 1 weight_filler { type: "xavier" } } }
+layer { name: "bn" type: "BatchNorm" bottom: "c" top: "c" }
+layer { name: "ip" type: "InnerProduct" bottom: "c" top: "logits"
+  inner_product_param { num_output: 3 weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "logits" bottom: "label" top: "loss" }
+"""
+    sp = config.parse_solver_prototxt('base_lr: 0.05 lr_policy: "fixed" momentum: 0.9')
+    solver = Solver(sp, net_param=config.parse_net_prototxt(net))
+    mesh = make_mesh({"dp": 2}, devices=jax.devices()[:2])
+    trainer = ParameterAveragingTrainer(solver, mesh)
+    st = trainer.init_state(seed=0)
+    rng = np.random.RandomState(0)
+    data = {
+        "x": rng.randn(2, 3, 8, 4, 2, 2).astype(np.float32),
+        "label": rng.randint(0, 3, (2, 3, 8)).astype(np.float32),
+    }
+    st, _ = trainer.round(st, shard_leading(data, mesh))
+    stats = np.asarray(st.stats["bn"][0])  # (workers, C) moving mean sums
+    np.testing.assert_allclose(stats[0], stats[1], rtol=1e-6)
+    assert not np.allclose(stats[0], 0.0)  # actually updated
