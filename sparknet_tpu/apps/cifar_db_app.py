@@ -1,0 +1,144 @@
+"""CifarDBApp — the DB-path training driver.
+
+Reference: ``src/main/scala/apps/CifarDBApp.scala`` — phase 1 writes
+per-worker DB shards + mean.binaryproto through the shim
+(``CreateDB``/``ComputeMean``), phase 2 trains with the engine's own
+``DataLayer`` reading those DBs (no callback data path).  Here phase 1
+writes native record DBs + the binary mean file, phase 2 feeds the same
+averaging loop from ``runtime.DataPipeline`` reader threads — the native
+data plane end to end.
+
+Run:
+    python -m sparknet_tpu.apps.cifar_db_app --workers=2 --rounds=6
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import tempfile
+
+import numpy as np
+
+
+def create_dbs(data_dir: str, out_dir: str, n_workers: int, seed: int = 0):
+    """Phase 1: shard train set into per-worker DBs, write test DB + mean
+    (CreateDB + ComputeMean parity)."""
+    from sparknet_tpu import runtime
+    from sparknet_tpu.data import CifarLoader
+    from sparknet_tpu.io import caffemodel
+
+    loader = CifarLoader(data_dir, seed=seed)
+    os.makedirs(out_dir, exist_ok=True)
+    paths = []
+    for w in range(n_workers):
+        path = os.path.join(out_dir, f"train_shard_{w}.sndb")
+        runtime.write_datum_db(
+            path, loader.train_images[w::n_workers], loader.train_labels[w::n_workers]
+        )
+        paths.append(path)
+    test_path = os.path.join(out_dir, "test.sndb")
+    runtime.write_datum_db(test_path, loader.test_images, loader.test_labels)
+    mean_path = os.path.join(out_dir, "mean.binaryproto")
+    caffemodel.save_mean_image(loader.mean_image, mean_path)
+    return paths, test_path, mean_path
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--data", default=None)
+    parser.add_argument("--db_dir", default=None)
+    parser.add_argument("--workers", type=int, default=2)
+    parser.add_argument("--rounds", type=int, default=6)
+    parser.add_argument("--tau", type=int, default=10)
+    parser.add_argument("--batch", type=int, default=100)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    import jax
+
+    from sparknet_tpu import models, runtime
+    from sparknet_tpu.data import CifarLoader
+    from sparknet_tpu.io import caffemodel
+    from sparknet_tpu.parallel import (
+        ParameterAveragingTrainer,
+        make_mesh,
+        shard_leading,
+    )
+    from sparknet_tpu.solver import Solver
+    from sparknet_tpu.utils import TrainingLog
+
+    log = TrainingLog(tag="cifar_db")
+    data_dir = args.data
+    if data_dir is None:
+        data_dir = tempfile.mkdtemp(prefix="cifar_synth_")
+        CifarLoader.write_synthetic(data_dir, num_train=4000, num_test=500)
+        log.log(f"synthesized CIFAR data in {data_dir}")
+    db_dir = args.db_dir or tempfile.mkdtemp(prefix="cifar_dbs_")
+
+    shard_paths, test_path, mean_path = create_dbs(
+        data_dir, db_dir, args.workers, args.seed
+    )
+    log.log(f"created {len(shard_paths)} train DBs + test DB in {db_dir} "
+            f"(native={runtime.native_available()})")
+
+    mean = caffemodel.load_mean_image(mean_path)
+    pipes = [
+        runtime.DataPipeline(
+            p,
+            batch_size=args.batch,
+            shape=(3, 32, 32),
+            mean=mean,
+            train=True,
+            seed=args.seed + w,
+        )
+        for w, p in enumerate(shard_paths)
+    ]
+    test_pipe = runtime.DataPipeline(
+        test_path, batch_size=args.batch, shape=(3, 32, 32), mean=mean, train=False
+    )
+
+    mesh = make_mesh(
+        {"dp": args.workers}, devices=jax.devices()[: args.workers]
+    )
+    solver = Solver(models.load_model_solver("cifar10_full"))
+    trainer = ParameterAveragingTrainer(solver, mesh)
+    state = trainer.init_state(seed=args.seed)
+    log.log("nets ready")
+
+    for r in range(args.rounds):
+        windows = []
+        for p in pipes:
+            batches = [p.next() for _ in range(args.tau)]
+            windows.append(
+                {
+                    "data": np.stack([b[0] for b in batches]),
+                    "label": np.stack([b[1] for b in batches]),
+                }
+            )
+        stacked = {k: np.stack([w[k] for w in windows]) for k in windows[0]}
+        state, _ = trainer.round(state, shard_leading(stacked, mesh))
+        log.log(f"round {r} trained, smoothed_loss {solver.smoothed_loss:.4f}")
+
+    # eval from the test DB
+    nb = 2
+    tb = [test_pipe.next() for _ in range(args.workers * nb)]
+    test_batches = {
+        "data": np.stack([b[0] for b in tb]).reshape(
+            args.workers, nb, args.batch, 3, 32, 32
+        ),
+        "label": np.stack([b[1] for b in tb]).reshape(args.workers, nb, args.batch),
+    }
+    scores = trainer.test_and_store_result(
+        state, shard_leading(test_batches, mesh)
+    )
+    acc = scores.get("accuracy", 0.0) / (args.workers * nb)
+    log.log(f"final accuracy {acc:.4f}")
+    for p in pipes:
+        p.close()
+    test_pipe.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

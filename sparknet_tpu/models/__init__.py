@@ -20,13 +20,13 @@ from sparknet_tpu.models import dsl  # noqa: F401
 
 ZOO_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "zoo")
 
+# prototxt-backed models: these three load from zoo/ files; caffenet /
+# googlenet / resnet50 are builder-backed (models/builders.py) — a name
+# lives in exactly one registry so resolution never depends on kwargs
 _NET_FILES = {
     "cifar10_full": "cifar10_full_train_test.prototxt",
     "lenet": "lenet_train_test.prototxt",
     "alexnet": "alexnet_train_val.prototxt",
-    "caffenet": "caffenet_train_val.prototxt",
-    "googlenet": "googlenet_train_val.prototxt",
-    "resnet50": "resnet50_train_val.prototxt",
 }
 
 _SOLVER_FILES = {
@@ -51,24 +51,25 @@ def available_models() -> List[str]:
 
 
 def load_model(name: str, **builder_kwargs) -> NetParameter:
-    """Load a zoo model by name: prototxt file if present, else the
-    programmatic builder (builders accept batch/image/classes overrides)."""
+    """Load a zoo model by name.  A name is either prototxt-backed (loads
+    its zoo/ file; kwargs rejected) or builder-backed (builders accept
+    batch/image/classes overrides) — never both."""
     from sparknet_tpu.models.builders import BUILDERS
 
-    path = os.path.join(ZOO_DIR, _NET_FILES.get(name, f"{name}.prototxt"))
-    if os.path.exists(path) and not builder_kwargs:
-        return load_net_prototxt(path)
     if name in BUILDERS:
         return BUILDERS[name](**builder_kwargs)
     if name not in _NET_FILES:
         raise KeyError(f"unknown model {name!r}; have {available_models()}")
-    if builder_kwargs and os.path.exists(path):
+    if builder_kwargs:
         raise ValueError(
             f"model {name!r} is prototxt-backed; overrides like "
             f"{sorted(builder_kwargs)} only apply to builder models — edit "
             f"the config or use config.replace_data_layers for batch shapes"
         )
-    raise FileNotFoundError(f"model config not in zoo yet: {path}")
+    path = os.path.join(ZOO_DIR, _NET_FILES[name])
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"model config missing from zoo: {path}")
+    return load_net_prototxt(path)
 
 
 def load_model_solver(name: str) -> SolverParameter:
