@@ -1,0 +1,142 @@
+"""Multi-head attention layer — TPU-native extension.
+
+The reference is a 2015 convnet framework with no attention anywhere
+(SURVEY §5: "Long-context / sequence parallelism: absent entirely"), but
+long-context is first-class here: this layer provides the single-device
+path, ``sparknet_tpu.parallel.ring_attention`` provides the
+sequence-parallel path over a mesh axis, and ``sparknet_tpu.ops.
+pallas_attention`` the fused TPU kernel.  All three compute the same
+function and are cross-checked in tests.
+
+Blob layout (Caffe-style ordered list): [w_qkv (E, 3E), b_qkv (3E),
+w_out (E, E), b_out (E)] with E = num_heads * head_dim.  Input/output
+(B, T, E).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from sparknet_tpu.config.schema import AttentionParameter, FillerParameter
+from sparknet_tpu.ops.base import BlobDef, Layer, register
+
+
+def mha_reference(q, k, v, causal: bool = False):
+    """Plain attention on (B, T, H, D) tensors; the semantic ground truth
+    for the blockwise/ring/pallas variants."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(d)
+    if causal:
+        tq, tk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        scores = jnp.where(mask, scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def blockwise_attention(q, k, v, block_size: int, causal: bool = False):
+    """Online-softmax blockwise attention over the KV sequence — the
+    memory-bounded form that ring attention distributes.  Matches
+    ``mha_reference`` exactly (up to float assoc)."""
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    nblocks = max(1, -(-tk // block_size))
+    pad = nblocks * block_size - tk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    kb = k.reshape(b, nblocks, block_size, h, d)
+    vb = v.reshape(b, nblocks, block_size, h, d)
+    scale = 1.0 / math.sqrt(d)
+    # end-aligned causal convention, same as mha_reference's tril(k=tk-tq):
+    # the last query attends to the last key
+    q_pos = (tk - tq) + jnp.arange(tq)
+
+    def body(i, carry):
+        acc, m, l = carry
+        k_i = kb[:, i]
+        v_i = vb[:, i]
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_i) * scale
+        k_pos = i * block_size + jnp.arange(block_size)
+        valid = k_pos < tk
+        if causal:
+            valid = valid[None, :] & (k_pos[None, :] <= q_pos[:, None])
+            s = jnp.where(valid[None, None], s, -jnp.inf)
+        else:
+            s = jnp.where(valid[None, None, None, :], s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # exp(-inf - -inf) guard: blocks where everything is masked
+        alpha = jnp.exp(jnp.where(m == -jnp.inf, 0.0, m - m_new))
+        p = jnp.exp(s - m_new[..., None])
+        p = jnp.where(jnp.isnan(p), 0.0, p)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_i)
+        return acc_new, m_new, l_new
+
+    acc = jnp.zeros((b, h, tq, d), q.dtype)
+    m = jnp.full((b, h, tq), -jnp.inf, q.dtype)
+    l = jnp.zeros((b, h, tq), q.dtype)
+    acc, m, l = jax.lax.fori_loop(0, nblocks, body, (acc, m, l))
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    return jnp.transpose(out, (0, 2, 1, 3))  # -> (B, T, H, D)
+
+
+@register
+class Attention(Layer):
+    """Self-attention over (B, T, E) bottoms."""
+
+    TYPE = "Attention"
+
+    def _p(self) -> AttentionParameter:
+        return self.lp.attention_param or AttentionParameter()
+
+    def _dims(self, bshape):
+        p = self._p()
+        e = bshape[-1]
+        head_dim = p.head_dim or e // max(1, p.num_heads)
+        if p.num_heads * head_dim != e:
+            raise ValueError(
+                f"layer {self.name!r}: num_heads*head_dim "
+                f"{p.num_heads}x{head_dim} != embed dim {e}"
+            )
+        return p.num_heads, head_dim, e
+
+    def blob_defs(self, bottom_shapes):
+        p = self._p()
+        _, _, e = self._dims(bottom_shapes[0])
+        wf = p.weight_filler or FillerParameter(type="xavier")
+        defs = [BlobDef((e, 3 * e), wf)]
+        if p.bias_term:
+            defs.append(BlobDef((3 * e,), FillerParameter(type="constant")))
+        defs.append(BlobDef((e, e), wf))
+        if p.bias_term:
+            defs.append(BlobDef((e,), FillerParameter(type="constant")))
+        return defs
+
+    def out_shapes(self, bottom_shapes):
+        return [bottom_shapes[0]]
+
+    def apply(self, blobs, bottoms, rng, train):
+        p = self._p()
+        x = bottoms[0]
+        h, d, e = self._dims(x.shape)
+        b, t, _ = x.shape
+        qkv = x @ blobs[0]
+        if p.bias_term:
+            qkv = qkv + blobs[1]
+        q, k, v = jnp.split(qkv.reshape(b, t, 3, h, d), 3, axis=2)
+        q, k, v = q[:, :, 0], k[:, :, 0], v[:, :, 0]
+        out = blockwise_attention(
+            q, k, v, block_size=min(p.block_size, t), causal=p.causal
+        )
+        from sparknet_tpu.ops.common import inverted_dropout
+
+        out = inverted_dropout(out, rng, p.dropout_ratio, train, self.name)
+        w_out_idx = 2 if p.bias_term else 1
+        y = out.reshape(b, t, e) @ blobs[w_out_idx]
+        if p.bias_term:
+            y = y + blobs[3]
+        return [y], None

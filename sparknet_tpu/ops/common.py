@@ -219,11 +219,20 @@ class Threshold(Layer):
         return [(bottoms[0] > t).astype(bottoms[0].dtype)], None
 
 
+def inverted_dropout(x, rng, ratio: float, train: bool, where: str):
+    """Shared inverted-dropout recipe (reference: ``dropout_layer.cpp``):
+    train scales kept units by 1/(1-ratio), test is identity."""
+    if not train or ratio == 0.0:
+        return x
+    if rng is None:
+        raise ValueError(f"dropout in {where!r} needs an rng in train")
+    keep = 1.0 - ratio
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)
+
+
 @register
 class Dropout(Layer):
-    """Inverted dropout: train scales kept units by 1/(1-ratio), test is
-    identity (reference: ``dropout_layer.cpp``)."""
-
     TYPE = "Dropout"
 
     def out_shapes(self, bottom_shapes):
@@ -233,14 +242,7 @@ class Dropout(Layer):
         ratio = (
             self.lp.dropout_param.dropout_ratio if self.lp.dropout_param else 0.5
         )
-        x = bottoms[0]
-        if not train or ratio == 0.0:
-            return [x], None
-        if rng is None:
-            raise ValueError(f"dropout layer {self.name!r} needs an rng in train")
-        keep = 1.0 - ratio
-        mask = jax.random.bernoulli(rng, keep, x.shape)
-        return [jnp.where(mask, x / keep, 0.0)], None
+        return [inverted_dropout(bottoms[0], rng, ratio, train, self.name)], None
 
 
 @register

@@ -1,0 +1,77 @@
+"""Fused attention kernel in Pallas — the hot-op custom kernel path.
+
+Per-(batch*head, q-block) grid cell: one MXU matmul Q.K^T, masked softmax
+on the VPU, one MXU matmul P.V — all in VMEM, no HBM round-trip for the
+scores matrix (the thing that makes naive attention bandwidth-bound).
+K/V live whole in VMEM per cell, which is fine for the single-chip
+sequence lengths this framework targets; beyond that the ring path
+(``parallel.ring_attention``) shards the sequence first and each shard's
+local attention goes through this kernel.
+
+On non-TPU backends the kernel runs in interpreter mode so tests pin it
+against ``mha_reference`` everywhere.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, tq, tk):
+    j = pl.program_id(1)
+    q = q_ref[0]  # (block_q, d)
+    k = k_ref[0]  # (tk, d)
+    v = v_ref[0]
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+    if causal:
+        # end-aligned causal convention (mha_reference's tril(k=tk-tq))
+        q_pos = (tk - tq) + j * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (q.shape[0], tk), 0
+        )
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (q.shape[0], tk), 1)
+        s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    o = jnp.dot(p, v, preferred_element_type=jnp.float32)
+    o_ref[0] = (o / jnp.sum(p, axis=-1, keepdims=True)).astype(o_ref.dtype)
+
+
+def flash_attention(
+    q, k, v, causal: bool = False, block_q: int = 128, interpret=None
+):
+    """Fused attention on (B, T, H, D); bit-comparable to
+    ``mha_reference`` (same softmax, fp32 accumulation)."""
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu",)
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    block_q = min(block_q, tq)
+    if tq % block_q:
+        raise ValueError(f"T_q {tq} not divisible by block_q {block_q}")
+    scale = 1.0 / math.sqrt(d)
+
+    def flat(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, x.shape[1], d)
+
+    qf, kf, vf = flat(q), flat(k), flat(v)
+    kernel = partial(
+        _attn_kernel, scale=scale, causal=causal, block_q=block_q, tq=tq, tk=tk
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, tq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return jnp.transpose(out.reshape(b, h, tq, d), (0, 2, 1, 3))

@@ -29,3 +29,7 @@ from sparknet_tpu.parallel.trainers import (  # noqa: F401
     replicate,
     shard_leading,
 )
+from sparknet_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
+    ring_self_attention,
+)
