@@ -74,7 +74,14 @@ class JaxNet:
         feed_shapes: Optional[Dict[str, Sequence[int]]] = None,
         stages: Sequence[str] = (),
         level: int = 0,
+        compute_dtype: Optional[str] = None,
     ):
+        # compute_dtype="bfloat16" runs layer compute in bf16 (params stay
+        # f32 master copies; loss layers upcast to f32) — the TPU-native
+        # mixed-precision recipe. None keeps full f32 (reference numerics).
+        self.compute_dtype = (
+            jnp.dtype(compute_dtype) if compute_dtype else None
+        )
         self.phase = phase.upper()
         state = NetState(phase=self.phase, level=level, stage=list(stages))
         self.net_param = filter_net(net_param, state)
@@ -247,13 +254,29 @@ class JaxNet:
         new_stats: Stats = {k: list(v) for k, v in stats.items()}
         loss = jnp.asarray(0.0, jnp.float32)
 
+        cd = self.compute_dtype
         for li, layer in enumerate(self.layers):
             lp = layer.lp
             if isinstance(layer, data_layers._HostFed):
+                # host blobs keep their dtype: index-valued blobs (labels)
+                # must never round through bf16; consumers cast as needed
                 tops = [blobs[t] for t in lp.top]
             else:
                 lblobs = self._gather_blobs(layer.name, params, new_stats)
                 bottoms = [blobs[b] for b in lp.bottom]
+                if cd is not None:
+                    if layer.IS_LOSS:
+                        # losses compute in f32 for stable log/exp; the
+                        # label bottom is f32 already (exact indices)
+                        bottoms = [b.astype(jnp.float32) for b in bottoms]
+                    elif not layer.MIXED_PRECISION_EXEMPT:
+                        lblobs = [b.astype(cd) for b in lblobs]
+                        bottoms = [
+                            b.astype(cd)
+                            if jnp.issubdtype(b.dtype, jnp.floating)
+                            else b
+                            for b in bottoms
+                        ]
                 lrng = jax.random.fold_in(rng, li) if rng is not None else None
                 tops, updated = layer.apply(lblobs, bottoms, lrng, train)
                 if updated is not None:
@@ -262,7 +285,12 @@ class JaxNet:
                         self._blob_defs[layer.name], refs, updated
                     ):
                         if ref.collection == "stats":
-                            new_stats[ref.owner][ref.index] = arr
+                            # keep stat blobs at their master dtype even
+                            # under bf16 compute
+                            cur = new_stats[ref.owner][ref.index]
+                            new_stats[ref.owner][ref.index] = arr.astype(
+                                cur.dtype
+                            )
             for w, top, name in zip(
                 self._loss_weights[layer.name], tops, lp.top
             ):

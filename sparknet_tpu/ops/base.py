@@ -47,6 +47,10 @@ class Layer:
     # loss layers get an implicit loss_weight of 1 on their first top
     # (reference: layer.hpp SetLossWeights + layer type name convention)
     IS_LOSS: bool = False
+    # layers that consume index-valued bottoms (labels, embedding ids,
+    # gather indices): never cast their inputs to a low-precision compute
+    # dtype — bf16 can only represent integers exactly up to 256
+    MIXED_PRECISION_EXEMPT: bool = False
 
     def __init__(self, lp: LayerParameter, phase: str):
         self.lp = lp

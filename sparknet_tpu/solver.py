@@ -161,8 +161,10 @@ class Solver:
         net_param: Optional[NetParameter] = None,
         feed_shapes: Optional[Dict[str, Sequence[int]]] = None,
         test_feed_shapes: Optional[Dict[str, Sequence[int]]] = None,
+        compute_dtype: Optional[str] = None,
     ):
         self.param = param
+        self.compute_dtype = compute_dtype
         self.method = solver_method(param)
         netp = net_param or param.net_param or param.train_net_param
         if netp is None:
@@ -171,7 +173,12 @@ class Solver:
                 raise ValueError("solver has no net definition")
             netp = load_net_prototxt(path)
         self.net_param = netp
-        self.net = JaxNet(netp, phase="TRAIN", feed_shapes=feed_shapes)
+        self.net = JaxNet(
+            netp,
+            phase="TRAIN",
+            feed_shapes=feed_shapes,
+            compute_dtype=compute_dtype,
+        )
         self._test_feed_shapes = test_feed_shapes or feed_shapes
         self._test_net: Optional[JaxNet] = None
         self._lr_mults, self._decay_mults = self.net.param_multipliers()
@@ -187,7 +194,10 @@ class Solver:
         has no valid TEST filtering."""
         if self._test_net is None:
             self._test_net = JaxNet(
-                self.net_param, phase="TEST", feed_shapes=self._test_feed_shapes
+                self.net_param,
+                phase="TEST",
+                feed_shapes=self._test_feed_shapes,
+                compute_dtype=self.compute_dtype,
             )
         return self._test_net
 
@@ -244,7 +254,9 @@ class Solver:
         for key, blobs in params.items():
             new_params[key] = []
             for idx, w in enumerate(blobs):
-                g = grads[key][idx] * inv_iter_size  # Normalize
+                # update math always in the master dtype (f32), even when
+                # the net computes in bf16
+                g = grads[key][idx].astype(w.dtype) * inv_iter_size  # Normalize
                 lr_mult = self._lr_mults[key][idx]
                 decay_mult = self._decay_mults[key][idx]
                 decay = p.weight_decay * decay_mult
@@ -285,6 +297,37 @@ class Solver:
             )
 
         return jax.lax.scan(one_iter, state, batches)
+
+    def _step_repeat(self, state: TrainState, batch, rng, tau: int):
+        """tau iterations reusing one batch (no per-iter host dispatch) —
+        the benchmarking fast path."""
+
+        def one_iter(st: TrainState, _):
+            lrng = jax.random.fold_in(rng, st.iter)
+            grads, loss, new_stats = self._grads(st.params, st.stats, batch, lrng)
+            new_params, new_history = self._apply_update(
+                st.params, st.history, grads, st.iter
+            )
+            return (
+                TrainState(new_params, new_stats, new_history, st.iter + 1),
+                loss,
+            )
+
+        return jax.lax.scan(one_iter, state, None, length=tau)
+
+    def step_repeat(self, state: TrainState, batch, tau: int, rng=None):
+        """Run ``tau`` iterations on the SAME device-resident batch inside
+        one jitted program.  One dispatch for the whole window — use for
+        throughput measurement (bench.py) or single-batch overfit tests."""
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        if not hasattr(self, "_jit_step_repeat"):
+            self._jit_step_repeat = jax.jit(
+                self._step_repeat, donate_argnums=(0,), static_argnums=(3,)
+            )
+        state, losses = self._jit_step_repeat(state, batch, rng, tau)
+        for l in list(jax.device_get(losses)):
+            self._loss_window.append(float(l))
+        return state, losses
 
     def step(
         self, state: TrainState, batches: Dict[str, jax.Array], rng=None

@@ -59,6 +59,12 @@ def profile_net(
             continue
         lblobs = net._gather_blobs(layer.name, params, stats)
         bottoms = [jax.device_put(blobs[b]) for b in layer.lp.bottom]
+        cd = net.compute_dtype
+        if cd is not None:
+            if layer.IS_LOSS:
+                bottoms = [b.astype("float32") for b in bottoms]
+            else:
+                lblobs = [b.astype(cd) for b in lblobs]
         lrng = jax.random.fold_in(rng, li)
 
         def run(lb, bt):

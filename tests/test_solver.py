@@ -292,3 +292,34 @@ layer { name: "acc" type: "Accuracy" bottom: "ip" bottom: "label" top: "acc"
     assert set(scores) == {"acc"}
     acc = scores["acc"] / 6.0  # driver divides by num batches
     assert 0.0 <= acc <= 1.0
+
+
+def test_step_repeat_matches_step_on_same_batch():
+    s1 = _solver("momentum: 0.9")
+    st1 = s1.init_state(0)
+    batch = _batch()
+    st1, l1 = s1.step_repeat(st1, batch, tau=4, rng=jax.random.PRNGKey(3))
+    s2 = _solver("momentum: 0.9")
+    st2 = s2.init_state(0)
+    st2, l2 = s2.step(st2, _stack(batch, 4), rng=jax.random.PRNGKey(3))
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(st1.params["ip"][0]), np.asarray(st2.params["ip"][0]), rtol=1e-6
+    )
+    assert int(st1.iter) == 4
+
+
+def test_bfloat16_compute_keeps_f32_masters():
+    import jax.numpy as jnp
+
+    sp = config.parse_solver_prototxt('base_lr: 0.1 lr_policy: "fixed" momentum: 0.9')
+    s = Solver(sp, net_param=config.parse_net_prototxt(REGRESS_NET),
+               compute_dtype="bfloat16")
+    st = s.init_state(0)
+    batch = _batch()
+    for _ in range(5):
+        st, losses = s.step(st, _stack(batch, 5))
+    assert st.params["ip"][0].dtype == jnp.float32  # master weights
+    assert st.history["ip"][0].dtype == jnp.float32
+    # still learns (bf16 tolerance)
+    assert float(losses[-1]) < 1.0
