@@ -1,0 +1,62 @@
+"""Virtual device-platform control for tests and dryruns.
+
+Multi-chip shardings are validated without multi-chip hardware the way
+SURVEY.md §4 prescribes: force ``n`` virtual CPU devices via
+``--xla_force_host_platform_device_count`` and run the real pjit/shard_map
+paths on that mesh. The axon TPU tunnel registers itself via sitecustomize
+at interpreter start and pins ``JAX_PLATFORMS=axon``, so plain env vars are
+not enough — the live jax config must be flipped back to cpu before (or in
+spite of) any backend use.
+"""
+
+import os
+import re
+
+_COUNT_FLAG = "xla_force_host_platform_device_count"
+
+
+def force_virtual_cpu_devices(n_devices: int) -> None:
+    """Force jax onto at least ``n_devices`` virtual CPU devices.
+
+    Safe to call before or after ``import jax``; must be called before the
+    first *use* of a backend in this process for the flag to take effect (XLA
+    parses ``XLA_FLAGS`` once per process — if a backend already initialised
+    with a smaller count, the best we can do is reset it and re-check).
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(rf"--{_COUNT_FLAG}=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --{_COUNT_FLAG}={n_devices}"
+        ).strip()
+    elif int(m.group(1)) < n_devices:
+        os.environ["XLA_FLAGS"] = (
+            flags[: m.start()] + f"--{_COUNT_FLAG}={n_devices}" + flags[m.end() :]
+        )
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) < n_devices:
+        # A backend initialised before the env was set (e.g. the axon
+        # sitecustomize probed devices). Resetting makes jax rebuild the CPU
+        # client; this recovers platform pinning, but XLA_FLAGS is parsed
+        # only once per process, so a stale smaller device count cannot be
+        # fixed here — re-check and fail loudly rather than let callers hit
+        # confusing downstream mesh errors.
+        try:
+            jax.clear_backends()
+        except Exception:
+            from jax.extend import backend as _backend
+
+            _backend.clear_backends()
+        if len(jax.devices()) < n_devices:
+            raise RuntimeError(
+                f"need {n_devices} virtual CPU devices but jax sees "
+                f"{len(jax.devices())}; a backend initialised before "
+                f"XLA_FLAGS could take effect — set XLA_FLAGS="
+                f"--{_COUNT_FLAG}={n_devices} JAX_PLATFORMS=cpu in the "
+                f"environment before starting Python"
+            )
