@@ -37,9 +37,21 @@
 
 namespace {
 
-thread_local std::string g_last_error;
+// Last-error storage is a mutex-guarded global (NOT thread_local): errors
+// raised on the pipeline reader thread must be visible to the Python caller
+// thread that polls sn_last_error().
+std::mutex g_error_mutex;
+std::string g_last_error;
 
-void set_error(const std::string& msg) { g_last_error = msg; }
+void set_error(const std::string& msg) {
+  std::lock_guard<std::mutex> lock(g_error_mutex);
+  g_last_error = msg;
+}
+
+std::string last_error_copy() {
+  std::lock_guard<std::mutex> lock(g_error_mutex);
+  return g_last_error;
+}
 
 constexpr char kMagic[8] = {'S', 'N', 'D', 'B', '1', '\0', '\0', '\0'};
 
@@ -94,22 +106,35 @@ class RecordDB {
 
   size_t NumRecords() const { return offsets_.size(); }
 
-  // Sequential cursor read; wraps are the caller's concern.
-  bool ReadAt(size_t idx, std::string* key, std::string* value) {
-    if (idx >= offsets_.size()) {
-      set_error("record index out of range");
+  // Sequential cursor read; wraps are the caller's concern. On failure the
+  // specific reason is written to *err (when given) as well as the global
+  // last-error — callers on reader threads use *err to avoid racing on the
+  // shared global.
+  bool ReadAt(size_t idx, std::string* key, std::string* value,
+              std::string* err = nullptr) {
+    auto fail = [&](const std::string& msg) {
+      if (err) *err = msg;
+      set_error(msg);
       return false;
+    };
+    if (idx >= offsets_.size()) {
+      return fail("record index out of range");
     }
     std::lock_guard<std::mutex> g(mu_);
     in_.seekg(offsets_[idx]);
     uint32_t kl = 0, vl = 0;
     in_.read(reinterpret_cast<char*>(&kl), 4);
     key->resize(kl);
-    in_.read(&(*key)[0], kl);
+    if (kl) in_.read(&(*key)[0], kl);
     in_.read(reinterpret_cast<char*>(&vl), 4);
     value->resize(vl);
-    in_.read(&(*value)[0], vl);
-    return static_cast<bool>(in_);
+    if (vl) in_.read(&(*value)[0], vl);
+    if (!in_) {
+      in_.clear();  // don't poison subsequent reads
+      return fail("read failed at record " + std::to_string(idx) + " in " +
+                  path_);
+    }
+    return true;
   }
 
  private:
@@ -248,7 +273,10 @@ class Pipeline {
   bool Next(float* data_out, float* label_out) {
     Batch b;
     if (!queue_.Pop(&b, stop_)) {
-      set_error("pipeline stopped");
+      // Surface the reader thread's sticky error if it recorded one;
+      // otherwise this is an ordinary stop.
+      std::string err = GetError();
+      set_error(err.empty() ? "pipeline stopped" : err);
       return false;
     }
     std::memcpy(data_out, b.data.data(), b.data.size() * sizeof(float));
@@ -267,16 +295,18 @@ class Pipeline {
       b.data.resize(size_t(cfg_.batch) * cfg_.c * out_h_ * out_w_);
       b.labels.resize(cfg_.batch);
       for (int i = 0; i < cfg_.batch && !stop_.load(); ++i) {
-        if (!db_->ReadAt(idx, &key, &value)) {
+        std::string read_err;
+        if (!db_->ReadAt(idx, &key, &value, &read_err)) {
+          SetError(read_err);
           stop_.store(true);
           break;
         }
         idx = (idx + 1) % n;  // epoch wrap, deterministic order like the
                               // reference's sequential cursor
         if (value.size() != record_bytes) {
-          set_error("record size mismatch: got " +
-                    std::to_string(value.size()) + ", want " +
-                    std::to_string(record_bytes));
+          SetError("record size mismatch: got " +
+                   std::to_string(value.size()) + ", want " +
+                   std::to_string(record_bytes));
           stop_.store(true);
           break;
         }
@@ -329,12 +359,25 @@ class Pipeline {
     }
   }
 
+  // Per-pipeline sticky error, set on the reader thread, read by Next().
+  void SetError(const std::string& msg) {
+    std::lock_guard<std::mutex> lock(err_mutex_);
+    if (error_.empty()) error_ = msg;
+  }
+
+  std::string GetError() {
+    std::lock_guard<std::mutex> lock(err_mutex_);
+    return error_;
+  }
+
   RecordDB* db_;
   PipelineConfig cfg_;
   int out_h_, out_w_;
   BlockingQueue<Batch> queue_;
   std::mt19937 rng_;
   std::atomic<bool> stop_{false};
+  std::mutex err_mutex_;
+  std::string error_;
   std::thread thread_;
 };
 
@@ -346,7 +389,13 @@ class Pipeline {
 
 extern "C" {
 
-const char* sn_last_error() { return g_last_error.c_str(); }
+const char* sn_last_error() {
+  // Copy into a thread_local buffer so the returned pointer stays valid for
+  // the calling thread even if another thread sets a new error.
+  thread_local std::string buf;
+  buf = last_error_copy();
+  return buf.c_str();
+}
 
 void* sndb_open(const char* path, int write_mode) {
   return RecordDB::Open(path, write_mode != 0);

@@ -233,6 +233,52 @@ def _bind(cls: Type[Message], d: Dict[str, List[Any]], permissive: bool) -> Mess
     return msg
 
 
+# V1LayerParameter_LayerType enum name -> modern type string
+# (reference: upgrade_proto.cpp:852-936 UpgradeV1LayerType)
+_V1_LAYER_TYPES = {
+    "ABSVAL": "AbsVal",
+    "ACCURACY": "Accuracy",
+    "ARGMAX": "ArgMax",
+    "BNLL": "BNLL",
+    "CONCAT": "Concat",
+    "CONTRASTIVE_LOSS": "ContrastiveLoss",
+    "CONVOLUTION": "Convolution",
+    "DECONVOLUTION": "Deconvolution",
+    "DATA": "Data",
+    "DROPOUT": "Dropout",
+    "DUMMY_DATA": "DummyData",
+    "EUCLIDEAN_LOSS": "EuclideanLoss",
+    "ELTWISE": "Eltwise",
+    "EXP": "Exp",
+    "FLATTEN": "Flatten",
+    "HDF5_DATA": "HDF5Data",
+    "HDF5_OUTPUT": "HDF5Output",
+    "HINGE_LOSS": "HingeLoss",
+    "IM2COL": "Im2col",
+    "IMAGE_DATA": "ImageData",
+    "INFOGAIN_LOSS": "InfogainLoss",
+    "INNER_PRODUCT": "InnerProduct",
+    "LRN": "LRN",
+    "MEMORY_DATA": "MemoryData",
+    "MULTINOMIAL_LOGISTIC_LOSS": "MultinomialLogisticLoss",
+    "MVN": "MVN",
+    "POOLING": "Pooling",
+    "POWER": "Power",
+    "RELU": "ReLU",
+    "SIGMOID": "Sigmoid",
+    "SIGMOID_CROSS_ENTROPY_LOSS": "SigmoidCrossEntropyLoss",
+    "SILENCE": "Silence",
+    "SOFTMAX": "Softmax",
+    "SOFTMAX_LOSS": "SoftmaxWithLoss",
+    "SPLIT": "Split",
+    "SLICE": "Slice",
+    "TANH": "TanH",
+    "WINDOW_DATA": "WindowData",
+    "THRESHOLD": "Threshold",
+    "JAVA_DATA": "JavaData",
+}
+
+
 def _upgrade_net(net: "schema.NetParameter") -> None:
     """Fold legacy V1 constructs into the modern schema, at any nesting depth
     (reference: ``caffe/src/caffe/util/upgrade_proto.cpp``)."""
@@ -240,6 +286,9 @@ def _upgrade_net(net: "schema.NetParameter") -> None:
         net.layer = list(net.layers) + list(net.layer)
         net.layers = []
     for layer in net.layer:
+        # V1 enum type names (CONVOLUTION, SOFTMAX_LOSS, ...) -> modern strings
+        if layer.type in _V1_LAYER_TYPES:
+            layer.type = _V1_LAYER_TYPES[layer.type]
         # V1 per-blob multipliers: blobs_lr -> ParamSpec.lr_mult,
         # weight_decay -> ParamSpec.decay_mult
         if layer.blobs_lr and not layer.param:

@@ -39,9 +39,14 @@ def encode_blob(arr: np.ndarray) -> bytes:
 
 def decode_blob(data) -> np.ndarray:
     fields = wire.collect_fields(data)
-    values = np.concatenate(
-        [wire.packed_floats(v) for v in fields.get(5, [])]
-    ) if 5 in fields else np.zeros(0, np.float32)
+    if 5 in fields:  # float data
+        values = np.concatenate([wire.packed_floats(v) for v in fields.get(5, [])])
+    elif 8 in fields:  # double_data (BlobProto field 8) -> float32
+        values = np.concatenate(
+            [wire.packed_doubles(v) for v in fields.get(8, [])]
+        ).astype(np.float32)
+    else:
+        values = np.zeros(0, np.float32)
     if 7 in fields:  # BlobShape
         shape_fields = wire.collect_fields(fields[7][-1])
         dims = []
@@ -54,6 +59,11 @@ def decode_blob(data) -> np.ndarray:
         if values.size and int(np.prod(shape)) != values.size:
             shape = (values.size,)
     if values.size == 0:
+        if int(np.prod(shape)) != 0:
+            raise ValueError(
+                f"BlobProto has shape {shape} but no data values (neither "
+                f"float data nor double_data present)"
+            )
         return np.zeros(shape, np.float32)
     return values.reshape(shape)
 
@@ -146,6 +156,20 @@ def net_blobs(net, params, stats) -> Blobs:
     return out
 
 
+def _legacy_align(arr: np.ndarray, target: Tuple[int, ...]) -> Optional[np.ndarray]:
+    """Right-align a legacy 4-D num/channels/height/width blob onto the
+    net's (possibly lower-rank) shape — ``Blob::ShapeEquals``/``LegacyShape``
+    semantics (blob.cpp:390-404): BVLC-era files store e.g. an IP weight as
+    (1, 1, M, N) and a bias as (1, 1, 1, N). Accept when the trailing dims
+    match and every leading dim is 1; return the reshaped array, else None."""
+    if arr.ndim != 4 or len(target) > 4:
+        return None
+    pad = (1,) * (4 - len(target)) + tuple(target)
+    if tuple(arr.shape) != pad:
+        return None
+    return arr.reshape(target)
+
+
 def apply_blobs(
     net, params, stats, loaded: Blobs, strict: bool = False
 ) -> Tuple[dict, dict]:
@@ -169,10 +193,13 @@ def apply_blobs(
             coll = params if ref.collection == "params" else stats
             cur = coll[ref.owner][ref.index]
             if tuple(cur.shape) != tuple(arr.shape):
-                raise ValueError(
-                    f"layer {layer.name!r}: blob shape {arr.shape} != "
-                    f"{tuple(cur.shape)}"
-                )
+                aligned = _legacy_align(arr, tuple(cur.shape))
+                if aligned is None:
+                    raise ValueError(
+                        f"layer {layer.name!r}: blob shape "
+                        f"{tuple(arr.shape)} != {tuple(cur.shape)}"
+                    )
+                arr = aligned
             coll[ref.owner][ref.index] = np.asarray(arr, np.float32)
         matched += 1
     if strict and matched == 0:

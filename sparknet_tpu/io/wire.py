@@ -129,6 +129,13 @@ def packed_floats(value, wire_type_hint=None) -> np.ndarray:
     return np.asarray([value], dtype=np.float32)
 
 
+def packed_doubles(value) -> np.ndarray:
+    """A packed (LEN) or repeated-unpacked double field -> float64 array."""
+    if isinstance(value, (bytes, memoryview)):
+        return np.frombuffer(value, dtype="<f8").copy()
+    return np.asarray([value], dtype=np.float64)
+
+
 def packed_varints(value) -> List[int]:
     if isinstance(value, (bytes, memoryview)):
         out = []

@@ -52,10 +52,10 @@ def _gaussian(key, shape, p, dtype):
     k1, k2 = jax.random.split(key)
     x = p.mean + p.std * jax.random.normal(k1, shape, dtype=dtype)
     if p.sparse >= 0:
-        # keep ~sparse non-zeros per output unit (bernoulli over fan-in,
-        # reference: filler.hpp GaussianFiller sparse_ handling)
-        fan_in, _ = _fans(shape)
-        prob = min(1.0, p.sparse / max(1, fan_in))
+        # keep ~sparse non-zeros per output unit: bernoulli with
+        # p = sparse / num_outputs where num_outputs = shape[0]
+        # (reference: filler.hpp:76-86 GaussianFiller sparse_ handling)
+        prob = min(1.0, p.sparse / max(1, shape[0]))
         mask = jax.random.bernoulli(k2, prob, shape)
         x = x * mask
     return x

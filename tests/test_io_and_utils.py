@@ -131,6 +131,55 @@ def test_weights_warm_start(tmp_path):
     assert int(st2.iter) == 0  # iter untouched by warm start
 
 
+def test_legacy_4d_blob_shapes_right_align(tmp_path):
+    """BVLC-era files store IP weights as (1,1,M,N) and biases as (1,1,1,N);
+    Blob::ShapeEquals right-aligns them (blob.cpp:390-404) — loading such a
+    file must succeed, not shape-mismatch."""
+    net = _solver().net
+    params, stats = net.init(seed=0)
+    w = np.random.RandomState(3).randn(8, 4).astype(np.float32)
+    b = np.random.RandomState(4).randn(8).astype(np.float32)
+
+    def legacy_blob(arr4d):
+        return (
+            wire.field_varint(1, arr4d.shape[0])
+            + wire.field_varint(2, arr4d.shape[1])
+            + wire.field_varint(3, arr4d.shape[2])
+            + wire.field_varint(4, arr4d.shape[3])
+            + wire.field_packed_floats(5, arr4d.reshape(-1))
+        )
+
+    layer_msg = (
+        wire.field_string(1, "ip1")
+        + wire.field_bytes(7, legacy_blob(w.reshape(1, 1, 8, 4)))
+        + wire.field_bytes(7, legacy_blob(b.reshape(1, 1, 1, 8)))
+    )
+    path = str(tmp_path / "legacy.caffemodel")
+    with open(path, "wb") as f:
+        f.write(wire.field_bytes(100, layer_msg))
+
+    loaded = caffemodel.load_weights(path)
+    params2, _ = caffemodel.apply_blobs(net, params, stats, loaded)
+    np.testing.assert_array_equal(params2["ip1"][0], w)
+    np.testing.assert_array_equal(params2["ip1"][1], b)
+
+
+def test_double_data_blob_decodes():
+    arr = np.random.RandomState(0).randn(3, 2).astype(np.float64)
+    msg = wire.field_bytes(
+        7, wire.field_packed_varints(1, arr.shape)
+    ) + wire.field_bytes(8, np.ascontiguousarray(arr, "<f8").tobytes())
+    dec = caffemodel.decode_blob(msg)
+    assert dec.dtype == np.float32
+    np.testing.assert_allclose(dec, arr.astype(np.float32))
+
+
+def test_blob_with_shape_but_no_data_raises():
+    msg = wire.field_bytes(7, wire.field_packed_varints(1, (2, 3)))
+    with pytest.raises(ValueError, match="no data"):
+        caffemodel.decode_blob(msg)
+
+
 def test_apply_blobs_shape_mismatch_raises():
     s = _solver()
     st = s.init_state(0)

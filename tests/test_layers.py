@@ -409,3 +409,17 @@ layer { name: "p" type: "Power" bottom: "x" top: "y"
     params, stats = net.init(0)
     fn = jax.jit(lambda p, s: net.apply(p, s, {}).blobs["y"])
     np.testing.assert_allclose(np.asarray(fn(params, stats)), 4.0)
+
+
+def test_sparse_gaussian_filler_probability():
+    """GaussianFiller sparse: non-zero probability = sparse / num_outputs
+    where num_outputs = shape[0] (filler.hpp:76-86)."""
+    from sparknet_tpu.config.schema import FillerParameter
+    from sparknet_tpu.ops import fillers
+
+    p = FillerParameter(type="gaussian", std=1.0, sparse=5)
+    x = np.asarray(
+        fillers.fill(jax.random.PRNGKey(0), (10, 1000), p)
+    )
+    frac = (x != 0).mean()  # expect ~ 5/10 = 0.5
+    assert 0.45 < frac < 0.55, frac

@@ -147,6 +147,27 @@ def test_legacy_layers_field_merges():
     assert n.layers == []
 
 
+def test_v1_enum_layer_types_upgrade():
+    """Genuine V1 prototxts use unquoted enum type names
+    (upgrade_proto.cpp:852-936 UpgradeV1LayerType)."""
+    n = config.parse_net_prototxt(
+        """
+        layers { name: "c" type: CONVOLUTION blobs_lr: 1 blobs_lr: 2
+          convolution_param { num_output: 4 kernel_size: 3 } }
+        layers { name: "ip" type: INNER_PRODUCT
+          inner_product_param { num_output: 2 } }
+        layers { name: "l" type: SOFTMAX_LOSS }
+        """
+    )
+    assert [l.type for l in n.layer] == [
+        "Convolution",
+        "InnerProduct",
+        "SoftmaxWithLoss",
+    ]
+    assert n.layer[0].param[0].lr_mult == 1.0
+    assert n.layer[0].param[1].lr_mult == 2.0
+
+
 def test_string_escapes_and_bool():
     n = config.parse_net_prototxt('name: "a\\"b" force_backward: true')
     assert n.name == 'a"b'

@@ -128,7 +128,9 @@ def test_pipeline_bad_record_size(native_built, tmp_path):
         db.put(b"k", b"\x01" + b"\x00" * 10)  # wrong size for 3x8x8
         db.commit()
     p = runtime.DataPipeline(str(path), batch_size=1, shape=(3, 8, 8))
-    with pytest.raises(IOError, match="size mismatch|stopped"):
+    # the reader thread's specific error must reach the caller thread
+    # (mutex-guarded global + per-pipeline sticky error, not thread_local)
+    with pytest.raises(IOError, match="size mismatch"):
         p.next()
     p.close()
 
