@@ -1,0 +1,246 @@
+"""ImageNetApp — distributed ImageNet training driver (the flagship app).
+
+Reference: ``src/main/scala/apps/ImageNetApp.scala`` — load tar shards from
+the bucket, force-resize to 256x256, compute + broadcast the mean image,
+then the parameter-averaging loop with tau=50 (``syncInterval``,
+``:155``), testing every 10 rounds (``:118``), with per-image random-crop
+(train) / center-crop (test) + mean-subtraction preprocessing closures
+(``:128-180``).
+
+TPU-native deltas:
+- The preprocessing closures run on-device inside the jitted round
+  (``sparknet_tpu.data.transforms``); minibatches cross host->device as
+  uint8 at full 256x256.
+- Broadcast + reduce of weights is the mesh collective inside
+  ``ParameterAveragingTrainer.round`` — weights never visit the host.
+- The mean image is computed in one streaming pass per partition and
+  reduced (``ComputeMean`` semantics), then saved as mean.binaryproto.
+
+Run:
+    python -m sparknet_tpu.apps.imagenet_app --data=DIR --workers=4
+(DIR holds tar shards + train.txt/val.txt; synthesizes JPEG shards when
+--data is omitted)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import tempfile
+
+import numpy as np
+
+TAU = 50  # reference: syncInterval = 50, ImageNetApp.scala:155
+FULL_SIZE = 256  # fullHeight/fullWidth, ImageNetApp.scala:23-24
+CROP_SIZE = 227  # croppedHeight/croppedWidth, ImageNetApp.scala:25-26
+
+
+def load_minibatch_partitions(
+    loader, prefix: str, labels_file: str, n_workers: int, batch: int,
+    height: int, width: int,
+):
+    """Partition shards over workers and pack each partition into uint8
+    minibatches (materialized — performance is best if the data fits in
+    memory, same caveat as the reference app's .persist())."""
+    from sparknet_tpu.data import ScaleAndConvert
+
+    conv = ScaleAndConvert(batch, height, width)
+    parts = loader.partitions(prefix, labels_file, num_parts=n_workers)
+    out = []
+    for part in parts:
+        mbs = list(conv.make_minibatches(part))
+        out.append(mbs)
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--data", default=None,
+                        help="dir with tar shards + train.txt/val.txt")
+    parser.add_argument("--train_prefix", default="train.")
+    parser.add_argument("--test_prefix", default="val.")
+    parser.add_argument("--train_labels", default="train.txt")
+    parser.add_argument("--test_labels", default="val.txt")
+    parser.add_argument("--model", default="alexnet",
+                        help="alexnet | caffenet | googlenet | resnet50")
+    parser.add_argument("--workers", type=int, default=0, help="0 = all devices")
+    parser.add_argument("--rounds", type=int, default=10)
+    parser.add_argument("--tau", type=int, default=0, help="0 = reference (50)")
+    parser.add_argument("--test_every", type=int, default=10)
+    parser.add_argument("--train_batch", type=int, default=0)
+    parser.add_argument("--test_batch", type=int, default=0)
+    parser.add_argument("--full_size", type=int, default=0)
+    parser.add_argument("--crop", type=int, default=0)
+    parser.add_argument("--classes", type=int, default=1000)
+    parser.add_argument("--no_mirror", action="store_true")
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    import jax
+
+    from sparknet_tpu import config as cfg, models
+    from sparknet_tpu.data import (
+        ImageNetLoader,
+        MinibatchSampler,
+        compute_mean,
+        reduce_mean_sums,
+        transforms,
+        write_synthetic_imagenet,
+    )
+    from sparknet_tpu.io.caffemodel import save_mean_image
+    from sparknet_tpu.parallel import (
+        ParameterAveragingTrainer,
+        make_mesh,
+        shard_leading,
+    )
+    from sparknet_tpu.solver import Solver
+    from sparknet_tpu.utils import TrainingLog
+
+    log = TrainingLog(tag="imagenet")
+    synthetic = args.data is None
+    if synthetic:
+        # scaled-down defaults so the offline demo fits one host
+        args.train_batch = args.train_batch or 8
+        args.test_batch = args.test_batch or 4
+        args.tau = args.tau or 4
+        args.full_size = args.full_size or 64
+        args.crop = args.crop or 56
+        args.classes = min(args.classes, 4)
+        data_dir = tempfile.mkdtemp(prefix="imagenet_synth_")
+        n_shards = max(2, args.workers or jax.local_device_count())
+        write_synthetic_imagenet(
+            data_dir, num_shards=n_shards,
+            images_per_shard=args.train_batch * (args.tau + 1),
+            classes=args.classes, seed=args.seed,
+        )
+        write_synthetic_imagenet(
+            data_dir, num_shards=n_shards,
+            images_per_shard=args.test_batch * 2, classes=args.classes,
+            labels_file="val.txt", shard_prefix="val.", seed=args.seed + 1,
+        )
+        log.log(f"synthesized JPEG tar shards in {data_dir}")
+    else:
+        # reference constants (ImageNetApp.scala:20-26)
+        args.train_batch = args.train_batch or 256
+        args.test_batch = args.test_batch or 50
+        args.tau = args.tau or TAU
+        args.full_size = args.full_size or FULL_SIZE
+        args.crop = args.crop or CROP_SIZE
+        data_dir = args.data
+
+    n_workers = args.workers or jax.local_device_count()
+    log.log(f"num workers: {n_workers}")
+
+    loader = ImageNetLoader(data_dir)
+    log.log("loading train data")
+    train_parts = load_minibatch_partitions(
+        loader, args.train_prefix, args.train_labels, n_workers,
+        args.train_batch, args.full_size, args.full_size,
+    )
+    num_train_mbs = sum(len(p) for p in train_parts)
+    log.log(f"numTrainMinibatches = {num_train_mbs}")
+    log.log("loading test data")
+    test_parts = load_minibatch_partitions(
+        loader, args.test_prefix, args.test_labels, n_workers,
+        args.test_batch, args.full_size, args.full_size,
+    )
+    num_test_mbs = sum(len(p) for p in test_parts)
+    log.log(f"numTestMinibatches = {num_test_mbs}")
+    if min(len(p) for p in train_parts) < args.tau:
+        raise SystemExit(
+            f"every worker needs >= tau={args.tau} train minibatches; "
+            f"partition sizes {[len(p) for p in train_parts]}"
+        )
+    if min(len(p) for p in test_parts) == 0:
+        raise SystemExit(
+            f"every worker needs >= 1 test minibatch; partition sizes "
+            f"{[len(p) for p in test_parts]} (fewer val shards than "
+            f"workers? reduce --workers or add shards)"
+        )
+
+    log.log("computing mean image")
+    mean = reduce_mean_sums(
+        [compute_mean(iter(p), return_sum=True) for p in train_parts]
+    )
+    mean_path = os.path.join(data_dir, "mean.binaryproto")
+    save_mean_image(mean, mean_path)
+    log.log(f"mean image -> {mean_path}")
+
+    # per-worker samplers over that worker's partition (contiguous random
+    # window of tau per round, MinibatchSampler semantics)
+    samplers = [
+        MinibatchSampler(
+            {
+                "data": np.stack([mb[0] for mb in part]),
+                "label": np.stack([mb[1].astype(np.float32) for mb in part]),
+            },
+            num_sampled_batches=args.tau,
+            seed=args.seed + w,
+        )
+        for w, part in enumerate(train_parts)
+    ]
+    # test batches: equal count per worker for the stacked eval
+    per_worker_test = min(len(p) for p in test_parts)
+    test_batches = {
+        "data": np.stack(
+            [np.stack([mb[0] for mb in p[:per_worker_test]]) for p in test_parts]
+        ),
+        "label": np.stack(
+            [
+                np.stack(
+                    [mb[1].astype(np.float32) for mb in p[:per_worker_test]]
+                )
+                for p in test_parts
+            ]
+        ),
+    }
+    num_test_used = per_worker_test * n_workers
+    del train_parts, test_parts  # samplers/test_batches hold the only copy
+
+    # net: cropped feed shapes (replaceDataLayers, ImageNetApp.scala:103-104)
+    netp = models.load_model(args.model) if args.model in (
+        "cifar10_full", "lenet", "alexnet"
+    ) else models.load_model(args.model, classes=args.classes)
+    netp = cfg.replace_data_layers(
+        netp,
+        [(args.train_batch, 3, args.crop, args.crop), (args.train_batch,)],
+        [(args.test_batch, 3, args.crop, args.crop), (args.test_batch,)],
+    )
+    solver_param = models.load_model_solver(args.model).copy()
+    solver = Solver(
+        solver_param,
+        net_param=netp,
+        train_transform=transforms.train_transform(
+            mean, args.crop, mirror=not args.no_mirror
+        ),
+        test_transform=transforms.test_transform(mean, args.crop),
+    )
+
+    mesh = make_mesh({"dp": n_workers}, devices=jax.devices()[:n_workers])
+    trainer = ParameterAveragingTrainer(solver, mesh)
+    state = trainer.init_state(seed=args.seed)
+    test_on_dev = shard_leading(test_batches, mesh)
+    log.log("finished setting up nets and weights")
+
+    for r in range(args.rounds):
+        if r % args.test_every == 0:  # test-then-train, ImageNetApp.scala:118
+            scores = trainer.test_and_store_result(state, test_on_dev)
+            acc = scores.get("accuracy", 0.0) / max(1, num_test_used)
+            log.log(f"{acc * 100:.2f}% accuracy", i=r)
+        log.log("training", i=r)
+        windows = [s.next_window() for s in samplers]
+        stacked = {k: np.stack([w[k] for w in windows]) for k in windows[0]}
+        state, _ = trainer.round(state, shard_leading(stacked, mesh))
+        log.log(
+            f"trained, smoothed_loss {solver.smoothed_loss:.4f}", i=r
+        )
+
+    scores = trainer.test_and_store_result(state, test_on_dev)
+    acc = scores.get("accuracy", 0.0) / max(1, num_test_used)
+    log.log(f"final accuracy {acc * 100:.2f}%")
+    print(f"final accuracy {acc * 100:.2f}%")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

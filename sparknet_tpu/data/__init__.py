@@ -11,6 +11,14 @@ Replaces the reference's two data paths with one idiomatic TPU pattern:
 """
 
 from sparknet_tpu.data.cifar import CifarLoader  # noqa: F401
+from sparknet_tpu.data.imagenet import (  # noqa: F401
+    ImageNetLoader,
+    ScaleAndConvert,
+    compute_mean,
+    reduce_mean_sums,
+    write_synthetic_imagenet,
+)
 from sparknet_tpu.data.sampler import MinibatchSampler  # noqa: F401
 from sparknet_tpu.data.transformer import DataTransformer  # noqa: F401
+from sparknet_tpu.data import transforms  # noqa: F401
 from sparknet_tpu.data.prefetch import Prefetcher, device_prefetch  # noqa: F401

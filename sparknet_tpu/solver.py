@@ -162,7 +162,15 @@ class Solver:
         feed_shapes: Optional[Dict[str, Sequence[int]]] = None,
         test_feed_shapes: Optional[Dict[str, Sequence[int]]] = None,
         compute_dtype: Optional[str] = None,
+        train_transform=None,
+        test_transform=None,
     ):
+        # Per-phase preprocessing closures traced into the jitted step —
+        # the reference's imageNetTrain/TestPreprocessing host closures
+        # (ImageNetApp.scala:128-180) moved on-device.  train_transform:
+        # (batch, rng) -> batch; test_transform: (batch) -> batch.
+        self.train_transform = train_transform
+        self.test_transform = test_transform
         self.param = param
         self.compute_dtype = compute_dtype
         self.method = solver_method(param)
@@ -219,14 +227,19 @@ class Solver:
     def _grads(self, params, stats, batch, rng):
         grad_fn = jax.value_and_grad(self.net.loss_fn, has_aux=True)
         if self.param.iter_size == 1:
+            if self.train_transform is not None:
+                batch = self.train_transform(
+                    batch, jax.random.fold_in(rng, 0x7F)
+                )
             (loss, (_, new_stats)), g = grad_fn(params, stats, batch, rng, True)
             return g, loss, new_stats
 
         def micro(carry, mb):
             acc, st, i = carry
-            (loss, (_, st2)), g = grad_fn(
-                params, st, mb, jax.random.fold_in(rng, i), True
-            )
+            lrng = jax.random.fold_in(rng, i)
+            if self.train_transform is not None:
+                mb = self.train_transform(mb, jax.random.fold_in(lrng, 0x7F))
+            (loss, (_, st2)), g = grad_fn(params, st, mb, lrng, True)
             return (_tree_map(jnp.add, acc, g), st2, i + 1), loss
 
         zero = _zeros_like(params)
@@ -353,6 +366,8 @@ class Solver:
     # ------------------------------------------------------------------
     def _forward_test(self, params, stats, batches):
         def one(carry, batch):
+            if self.test_transform is not None:
+                batch = self.test_transform(batch)
             blobs = self.test_net.forward(params, stats, batch)
             outs = {
                 name: jnp.sum(blobs[name])

@@ -22,9 +22,14 @@ class TrainingLog:
         self._t0 = time.time()
         self._echo = echo
 
-    def log(self, message: str):
+    def log(self, message: str, i: int = -1):
+        """Reference line formats (ImageNetApp.scala:47-53): with a round
+        index, ``<elapsed>, i = <i>: <message>``; else ``<elapsed>: <msg>``."""
         elapsed = time.time() - self._t0
-        line = f"{elapsed:.3f}: {message}"
+        if i >= 0:
+            line = f"{elapsed:.3f}, i = {i}: {message}"
+        else:
+            line = f"{elapsed:.3f}: {message}"
         self._f.write(line + "\n")
         self._f.flush()
         if self._echo:
