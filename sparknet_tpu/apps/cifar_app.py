@@ -65,33 +65,43 @@ def main(argv=None) -> int:
         raise SystemExit(
             f"need >= {n_workers * args.tau} minibatches, have {len(x)}"
         )
-    # repartition: worker w takes every n-th batch (RDD repartition analog)
+    # repartition into contiguous near-equal blocks (RDD repartition
+    # analog) — partition sizes may differ by one batch; each worker's
+    # window sampler draws tau from its OWN partition size
     samplers = [
         MinibatchSampler(
-            {"data": x[w::n_workers], "label": y[w::n_workers]},
+            {"data": xs, "label": ys},
             num_sampled_batches=args.tau,
             seed=args.seed + w,
         )
-        for w in range(n_workers)
+        for w, (xs, ys) in enumerate(
+            zip(np.array_split(x, n_workers), np.array_split(y, n_workers))
+        )
     ]
     xt, yt = loader.minibatches(args.batch, train=False)
-    nt = (len(xt) // n_workers) * n_workers
-    test_batches = {
-        "data": xt[:nt].reshape(n_workers, -1, *xt.shape[1:]),
-        "label": yt[:nt].reshape(n_workers, -1, yt.shape[1]),
-    }
-    num_test_batches = nt
+    # heterogeneous test partitions (Spark parallelize gives near-equal
+    # splits; ragged tails are scored, not dropped): pad-and-mask
+    test_parts = [
+        {"data": xs, "label": ys}
+        for xs, ys in zip(
+            np.array_split(xt, n_workers), np.array_split(yt, n_workers)
+        )
+    ]
+    num_test_batches = len(xt)
 
     mesh = make_mesh({"dp": n_workers}, devices=jax.devices()[:n_workers])
     solver = Solver(models.load_model_solver("cifar10_full"))
     trainer = ParameterAveragingTrainer(solver, mesh)
     state = trainer.init_state(seed=args.seed)
+    test_batches, test_counts = ParameterAveragingTrainer.pad_partitions(
+        test_parts
+    )
     test_on_dev = shard_leading(test_batches, mesh)
     log.log("finished setting up nets and weights")
 
     for r in range(args.rounds):
         if r % args.test_every == 0:  # test before train, CifarApp.scala:101
-            scores = trainer.test_and_store_result(state, test_on_dev)
+            scores = trainer.test_and_store_result(state, test_on_dev, counts=test_counts)
             acc = scores.get("accuracy", 0.0) / num_test_batches
             log.log(f"round {r}, accuracy {acc:.4f}")
         windows = [s.next_window() for s in samplers]
@@ -101,7 +111,7 @@ def main(argv=None) -> int:
         state, _ = trainer.round(state, shard_leading(stacked, mesh))
         log.log(f"round {r} trained, smoothed_loss {solver.smoothed_loss:.4f}")
 
-    scores = trainer.test_and_store_result(state, test_on_dev)
+    scores = trainer.test_and_store_result(state, test_on_dev, counts=test_counts)
     acc = scores.get("accuracy", 0.0) / num_test_batches
     log.log(f"final accuracy {acc:.4f}")
     return 0

@@ -179,22 +179,18 @@ def main(argv=None) -> int:
         )
         for w, part in enumerate(train_parts)
     ]
-    # test batches: equal count per worker for the stacked eval
-    per_worker_test = min(len(p) for p in test_parts)
-    test_batches = {
-        "data": np.stack(
-            [np.stack([mb[0] for mb in p[:per_worker_test]]) for p in test_parts]
-        ),
-        "label": np.stack(
-            [
-                np.stack(
-                    [mb[1].astype(np.float32) for mb in p[:per_worker_test]]
-                )
-                for p in test_parts
-            ]
-        ),
-    }
-    num_test_used = per_worker_test * n_workers
+    # test batches: heterogeneous per-worker counts, pad-and-mask — every
+    # minibatch is scored even when val shards split unevenly
+    test_batches, test_counts = ParameterAveragingTrainer.pad_partitions(
+        [
+            {
+                "data": np.stack([mb[0] for mb in p]),
+                "label": np.stack([mb[1].astype(np.float32) for mb in p]),
+            }
+            for p in test_parts
+        ]
+    )
+    num_test_used = int(test_counts.sum())
     del train_parts, test_parts  # samplers/test_batches hold the only copy
 
     # net: cropped feed shapes (replaceDataLayers, ImageNetApp.scala:103-104)
@@ -224,7 +220,7 @@ def main(argv=None) -> int:
 
     for r in range(args.rounds):
         if r % args.test_every == 0:  # test-then-train, ImageNetApp.scala:118
-            scores = trainer.test_and_store_result(state, test_on_dev)
+            scores = trainer.test_and_store_result(state, test_on_dev, counts=test_counts)
             acc = scores.get("accuracy", 0.0) / max(1, num_test_used)
             log.log(f"{acc * 100:.2f}% accuracy", i=r)
         log.log("training", i=r)
@@ -235,7 +231,7 @@ def main(argv=None) -> int:
             f"trained, smoothed_loss {solver.smoothed_loss:.4f}", i=r
         )
 
-    scores = trainer.test_and_store_result(state, test_on_dev)
+    scores = trainer.test_and_store_result(state, test_on_dev, counts=test_counts)
     acc = scores.get("accuracy", 0.0) / max(1, num_test_used)
     log.log(f"final accuracy {acc * 100:.2f}%")
     print(f"final accuracy {acc * 100:.2f}%")

@@ -26,6 +26,7 @@ from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 try:  # jax >= 0.6 exposes shard_map at top level
@@ -99,10 +100,15 @@ class ParameterAveragingTrainer:
             donate_argnums=(0,),
         )
 
-        def eval_body(state, batches):
+        def eval_body(state, batches, counts):
+            # heterogeneous partitions: every worker's batches are padded
+            # to the max count; only its own first `counts[w]` batches
+            # score (equal partitions just pass counts == nb everywhere)
             st = tree_map(lambda x: x[0], state)
             bt = tree_map(lambda x: x[0], batches)
-            scores = solver._forward_test(st.params, st.stats, bt)
+            scores = solver._forward_test(
+                st.params, st.stats, bt, count=counts[0]
+            )
             # global accumulation (the RDD reduce of test scores,
             # CifarApp.scala:113)
             return {k: jax.lax.psum(v, axis) for k, v in scores.items()}
@@ -111,7 +117,7 @@ class ParameterAveragingTrainer:
             shard_map(
                 eval_body,
                 mesh=mesh,
-                in_specs=(P(axis), P(axis)),
+                in_specs=(P(axis), P(axis), P(axis)),
                 out_specs=P(),
             )
         )
@@ -136,12 +142,38 @@ class ParameterAveragingTrainer:
         return state, losses
 
     def test_and_store_result(
-        self, state: TrainState, batches: Dict[str, jax.Array]
+        self, state: TrainState, batches: Dict[str, jax.Array], counts=None
     ) -> Dict[str, float]:
         """Distributed eval: ``batches[blob]`` is (num_workers, nb, ...);
-        returns accumulated scores over ALL workers' batches."""
-        out = self._eval(state, batches)
+        returns accumulated scores over ALL workers' batches.  With
+        heterogeneous test partitions, pad every worker to the same nb and
+        pass ``counts`` (num_workers,) int32 — each worker scores only its
+        own first ``counts[w]`` batches (the reference's per-partition
+        full-pass sampler, CifarApp.scala:103-106)."""
+        if counts is None:
+            nb = len(next(iter(batches.values()))[0])
+            counts = np.full((self.num_workers,), nb, np.int32)
+        out = self._eval(state, batches, jnp.asarray(counts, jnp.int32))
         return {k: float(v) for k, v in jax.device_get(out).items()}
+
+    @staticmethod
+    def pad_partitions(parts):
+        """Stack per-worker {blob: (nb_w, ...)} dicts of UNEQUAL nb_w into
+        ({blob: (N, nb_max, ...)} zero-padded, counts (N,)) for
+        ``test_and_store_result`` — the pad-and-mask layout."""
+        keys = parts[0].keys()
+        counts = np.array(
+            [len(next(iter(p.values()))) for p in parts], np.int32
+        )
+        nb_max = int(counts.max())
+        stacked = {}
+        for k in keys:
+            ref = parts[0][k]
+            out = np.zeros((len(parts), nb_max) + ref.shape[1:], ref.dtype)
+            for w, p in enumerate(parts):
+                out[w, : len(p[k])] = p[k]
+            stacked[k] = out
+        return stacked, counts
 
 
 class AllReduceTrainer:

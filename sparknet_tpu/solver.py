@@ -364,8 +364,15 @@ class Solver:
     # ------------------------------------------------------------------
     # Test (TestAndStoreResult semantics)
     # ------------------------------------------------------------------
-    def _forward_test(self, params, stats, batches):
-        def one(carry, batch):
+    def _forward_test(self, params, stats, batches, count=None):
+        """Accumulate test-output sums over the leading batch axis.  When
+        ``count`` is given (heterogeneous partitions: batches are padded to
+        a common length), only the first ``count`` batches contribute — the
+        pad-and-mask path that lets workers hold unequal test partition
+        sizes (reference tolerates this via per-partition samplers,
+        CifarApp.scala:103-106)."""
+
+        def one(i, batch):
             if self.test_transform is not None:
                 batch = self.test_transform(batch)
             blobs = self.test_net.forward(params, stats, batch)
@@ -373,7 +380,10 @@ class Solver:
                 name: jnp.sum(blobs[name])
                 for name in self._test_output_names()
             }
-            return carry, outs
+            if count is not None:
+                w = (i < count).astype(jnp.float32)
+                outs = {k: v * w for k, v in outs.items()}
+            return i + 1, outs
 
         _, outs = jax.lax.scan(one, 0, batches)
         return {k: jnp.sum(v) for k, v in outs.items()}

@@ -1,0 +1,71 @@
+"""Analytic model-FLOP counting for MFU reporting.
+
+XLA's ``compiled.cost_analysis()`` under-reports on some backends, so the
+benchmark cross-checks it against this shape walk.  Convention matches the
+standard MFU accounting: count the MXU work (convolutions and matmuls; a
+multiply-accumulate is 2 FLOPs), ignore elementwise/normalization tails,
+and charge the backward pass at 2x forward (grad-wrt-input + grad-wrt-
+weights each cost one forward).  Reference cost ground truth: AlexNet
+forward is ~1.4 GFLOPs/image at batch-size-independent shapes
+(``caffe/models/bvlc_alexnet``), so train ~4.3 GFLOPs/image.
+"""
+
+from __future__ import annotations
+
+from sparknet_tpu.net import JaxNet
+
+
+def _conv_flops(net: JaxNet, layer) -> float:
+    lp = layer.lp
+    cp = lp.convolution_param
+    (n, c, _, _) = net.blob_shapes[lp.bottom[0]]
+    out = net.blob_shapes[lp.top[0]]
+    if lp.type == "Deconvolution":
+        # the GEMM runs over the *input* spatial extent
+        _, k, _, _ = out
+        _, _, oh, ow = net.blob_shapes[lp.bottom[0]]
+    else:
+        _, k, oh, ow = out
+    g = max(1, cp.group)
+    (kh, kw), _, _, _ = layer._geometry(net.blob_shapes[lp.bottom[0]])
+    macs = n * oh * ow * k * (c // g) * kh * kw
+    if cp.bias_term:
+        macs += n * k * oh * ow
+    return 2.0 * macs
+
+
+def _ip_flops(net: JaxNet, layer) -> float:
+    lp = layer.lp
+    bshape = net.blob_shapes[lp.bottom[0]]
+    p = lp.inner_product_param
+    axis = p.axis if p.axis >= 0 else len(bshape) + p.axis
+    n = 1
+    for d in bshape[:axis]:
+        n *= d
+    fan_in = 1
+    for d in bshape[axis:]:
+        fan_in *= d
+    macs = n * fan_in * p.num_output
+    if p.bias_term:
+        macs += n * p.num_output
+    return 2.0 * macs
+
+
+def forward_flops(net: JaxNet) -> float:
+    """MXU FLOPs for one forward pass at the net's static shapes."""
+    total = 0.0
+    for layer in net.layers:
+        t = layer.lp.type
+        if t in ("Convolution", "Deconvolution"):
+            total += _conv_flops(net, layer)
+        elif t == "InnerProduct":
+            total += _ip_flops(net, layer)
+        elif t == "Embed":
+            # gather, not matmul — negligible
+            continue
+    return total
+
+
+def train_flops(net: JaxNet) -> float:
+    """Forward + backward (2x forward) per training iteration."""
+    return 3.0 * forward_flops(net)

@@ -65,6 +65,11 @@ def profile_net(
                 bottoms = [b.astype("float32") for b in bottoms]
             else:
                 lblobs = [b.astype(cd) for b in lblobs]
+                bottoms = [
+                    b.astype(cd) if jax.numpy.issubdtype(b.dtype, jax.numpy.floating)
+                    else b
+                    for b in bottoms
+                ]
         lrng = jax.random.fold_in(rng, li)
 
         def run(lb, bt):

@@ -423,3 +423,58 @@ def test_sparse_gaussian_filler_probability():
     )
     frac = (x != 0).mean()  # expect ~ 5/10 = 0.5
     assert 0.45 < frac < 0.55, frac
+
+
+def test_lrn_fast_negpow_matches_pow():
+    """The sqrt/rsqrt chain used by the LRN normalizer equals ``s**-beta``
+    for every quarter-integer beta (and falls back to pow otherwise)."""
+    from sparknet_tpu.ops.vision import _fast_negpow
+
+    s = jnp.abs(jnp.asarray(RNG.randn(512), jnp.float32)) + 0.3
+    for beta in (0.25, 0.5, 0.75, 1.0, 1.25, 1.5, 2.0, 0.6, 3.14):
+        np.testing.assert_allclose(
+            np.asarray(_fast_negpow(s, beta)),
+            np.asarray(jnp.power(s, -beta)),
+            rtol=2e-5,
+        )
+
+
+def test_pallas_lrn_matches_xla_path():
+    """The Pallas LRN kernel (interpret mode off-TPU) pins value and
+    gradient against the XLA custom_vjp path."""
+    from sparknet_tpu.ops.pallas_lrn import lrn_across_channels as pl_lrn
+    from sparknet_tpu.ops.vision import lrn_across_channels as xla_lrn
+
+    for shape, n, alpha, beta, k in [
+        ((2, 32, 7, 5), 5, 1e-4, 0.75, 1.0),
+        ((1, 16, 4, 4), 3, 0.5, 0.6, 2.0),
+        ((2, 8, 5, 5), 11, 0.1, 0.75, 1.0),  # window wider than C
+    ]:
+        x = jnp.asarray(RNG.randn(*shape), jnp.float32) * 2
+        np.testing.assert_allclose(
+            np.asarray(pl_lrn(x, n, alpha, beta, k)),
+            np.asarray(xla_lrn(x, n, alpha, beta, k)),
+            atol=1e-5,
+        )
+        g1 = jax.grad(lambda v: jnp.sum(jnp.sin(pl_lrn(v, n, alpha, beta, k))))(x)
+        g2 = jax.grad(lambda v: jnp.sum(jnp.sin(xla_lrn(v, n, alpha, beta, k))))(x)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-5)
+
+
+def test_analytic_flops_alexnet():
+    """The MFU flop walk lands on the known AlexNet cost (~1.4 GFLOPs/img
+    forward, conv+fc only)."""
+    from sparknet_tpu import models
+    from sparknet_tpu.config import replace_data_layers
+    from sparknet_tpu.net import JaxNet
+    from sparknet_tpu.utils import flops
+
+    netp = replace_data_layers(
+        models.load_model("alexnet"),
+        [(1, 3, 227, 227), (1,)],
+        [(1, 3, 227, 227), (1,)],
+    )
+    net = JaxNet(netp, phase="TRAIN")
+    fwd = flops.forward_flops(net)
+    assert 1.3e9 < fwd < 1.6e9, fwd
+    assert flops.train_flops(net) == 3.0 * fwd

@@ -251,3 +251,72 @@ layer { name: "loss" type: "SoftmaxWithLoss" bottom: "logits" bottom: "label" to
     stats = np.asarray(st.stats["bn"][0])  # (workers, C) moving mean sums
     np.testing.assert_allclose(stats[0], stats[1], rtol=1e-6)
     assert not np.allclose(stats[0], 0.0)  # actually updated
+
+
+def test_heterogeneous_test_partitions_masked_eval():
+    """Workers hold UNEQUAL test partition sizes (pad-and-mask): the
+    accumulated scores must equal a single-device pass over the
+    concatenation of every worker's real batches — padded slots must not
+    score (the reference's per-partition full-pass sampler semantics,
+    CifarApp.scala:103-106)."""
+    mesh = make_mesh({"dp": 4}, devices=jax.devices()[:4])
+    solver = _solver()
+    trainer = ParameterAveragingTrainer(solver, mesh)
+    st = trainer.init_state(seed=0)
+
+    rng = np.random.RandomState(11)
+    sizes = [5, 2, 3, 1]
+    parts = [
+        {
+            "x": rng.randn(nb, 8, 6).astype(np.float32),
+            "label": rng.randint(0, 4, (nb, 8)).astype(np.float32),
+        }
+        for nb in sizes
+    ]
+    batches, counts = ParameterAveragingTrainer.pad_partitions(parts)
+    assert batches["x"].shape == (4, 5, 8, 6)
+    assert list(counts) == sizes
+    scores = trainer.test_and_store_result(
+        st, shard_leading(batches, mesh), counts=counts
+    )
+
+    # single-device truth over the concatenated real batches
+    single = solver.init_state(seed=0)
+    cat = {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
+    want = solver.test_and_store_result(single, cat)
+    assert set(scores) == set(want)
+    for k in want:
+        np.testing.assert_allclose(scores[k], want[k], rtol=1e-5)
+
+
+def test_heterogeneous_train_partitions_window_sampling():
+    """Workers with different train partition sizes still run tau-step
+    rounds: each worker's sampler draws its window from its OWN partition
+    (trainPartitionSizes semantics) and the stacked round works."""
+    from sparknet_tpu.data import MinibatchSampler
+
+    mesh = make_mesh({"dp": 4}, devices=jax.devices()[:4])
+    solver = _solver()
+    trainer = ParameterAveragingTrainer(solver, mesh)
+    st = trainer.init_state(seed=0)
+
+    tau = 3
+    rng = np.random.RandomState(12)
+    sizes = [3, 7, 4, 10]  # all >= tau, otherwise the reference fails too
+    samplers = [
+        MinibatchSampler(
+            {
+                "x": rng.randn(nb, 8, 6).astype(np.float32),
+                "label": rng.randint(0, 4, (nb, 8)).astype(np.float32),
+            },
+            num_sampled_batches=tau,
+            seed=w,
+        )
+        for w, nb in enumerate(sizes)
+    ]
+    windows = [s.next_window() for s in samplers]
+    stacked = {k: np.stack([w[k] for w in windows]) for k in windows[0]}
+    assert stacked["x"].shape == (4, tau, 8, 6)
+    st, losses = trainer.round(st, shard_leading(stacked, mesh))
+    assert losses.shape == (4, tau)
+    assert np.isfinite(np.asarray(losses)).all()
