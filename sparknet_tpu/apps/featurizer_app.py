@@ -6,7 +6,10 @@ Here ``JaxNet.forward`` returns every blob, so the tap is a dict lookup.
 
 Run:
     python -m sparknet_tpu.apps.featurizer_app --model=NAME --blob=ip1 \
-        [--weights=F.caffemodel] [--batches=4] [--out=features.npz]
+        --data=DIR|DB [--weights=F.caffemodel] [--batches=4] \
+        [--out=features.npz]
+(real minibatches come from --data or the net's Data-layer source;
+--allow_synthetic featurizes random batches for smoke tests only)
 """
 
 from __future__ import annotations
@@ -21,6 +24,10 @@ def main(argv=None) -> int:
     parser.add_argument("--model", default="cifar10_full")
     parser.add_argument("--blob", default="ip1")
     parser.add_argument("--weights", default=None)
+    parser.add_argument("--data", default=None,
+                        help="CIFAR binary dir or SNDB path")
+    parser.add_argument("--allow_synthetic", action="store_true",
+                        help="smoke-test only: featurize random batches")
     parser.add_argument("--batches", type=int, default=4)
     parser.add_argument("--out", default=None)
     args = parser.parse_args(argv)
@@ -28,6 +35,7 @@ def main(argv=None) -> int:
     import jax
 
     from sparknet_tpu import models
+    from sparknet_tpu.data.source import resolve_batches
     from sparknet_tpu.io import caffemodel
     from sparknet_tpu.net import JaxNet
 
@@ -43,18 +51,15 @@ def main(argv=None) -> int:
         loaded = caffemodel.load_weights(args.weights)
         params, stats = caffemodel.apply_blobs(net, params, stats, loaded)
 
-    rng = np.random.RandomState(0)
+    # real minibatches (FeaturizerApp.scala:88-103 pulls from the RDD)
+    stacked = resolve_batches(
+        net, netp, args.data, args.batches, phase="TEST",
+        allow_synthetic=args.allow_synthetic,
+    )
     feats = []
     fwd = jax.jit(net.forward)
     for i in range(args.batches):
-        batch = {}
-        for blob in net.feed_blobs:
-            shape = net.blob_shapes[blob]
-            batch[blob] = (
-                rng.randint(0, 10, shape).astype(np.float32)
-                if "label" in blob
-                else rng.randn(*shape).astype(np.float32)
-            )
+        batch = {k: v[i] for k, v in stacked.items()}
         blobs = fwd(params, stats, batch)
         if args.blob not in blobs:
             raise SystemExit(

