@@ -2,21 +2,25 @@
 
 Reference semantics (``solver.cpp:446-519``, ``sgd_solver.cpp:242-290``):
 a snapshot is the model weights (.caffemodel) plus SolverState (iter,
-current_step, history blobs); ``Restore`` resumes training exactly.  Here
-one snapshot is a pair of files:
+current_step, history blobs); ``Restore`` resumes training exactly.  Both
+reference snapshot formats are supported, chosen by
+``SolverParameter.snapshot_format`` (``solver.cpp:459-476``):
 
-- ``{prefix}_iter_{N}.caffemodel`` — params+stats, binary-compatible with
-  the reference format (loads in either direction),
-- ``{prefix}_iter_{N}.solverstate.npz`` — iter + flattened history pytree.
+- BINARYPROTO (default): ``{prefix}_iter_{N}.caffemodel`` (binary-
+  compatible with the reference wire format) +
+  ``{prefix}_iter_{N}.solverstate.npz`` (iter + flattened history pytree),
+- HDF5: ``{prefix}_iter_{N}.caffemodel.h5`` +
+  ``{prefix}_iter_{N}.solverstate.h5`` in the Net::ToHDF5 /
+  SnapshotSolverStateToHDF5 layouts (``io/hdf5.py``).
 
-``snapshot()``/``restore()`` round-trip bitwise.
+``snapshot()``/``restore()`` round-trip bitwise in either format; restore
+and warm-start detect the format from the file extension.
 """
 
 from __future__ import annotations
 
-import io as _io
 import os
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import numpy as np
@@ -30,40 +34,74 @@ def _flatten_history(history):
     return leaves, treedef
 
 
-def snapshot(solver: Solver, state: TrainState, prefix: str) -> Tuple[str, str]:
-    """Write model + solver state; returns (model_path, state_path)."""
+def snapshot(
+    solver: Solver, state: TrainState, prefix: str, fmt: str = None
+) -> Tuple[str, str]:
+    """Write model + solver state; returns (model_path, state_path).
+    ``fmt`` overrides ``solver.param.snapshot_format``."""
+    fmt = (fmt or solver.param.snapshot_format or "BINARYPROTO").upper()
     it = int(jax.device_get(state.iter))
-    model_path = f"{prefix}_iter_{it}.caffemodel"
-    state_path = f"{prefix}_iter_{it}.solverstate.npz"
-    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
     blobs = caffemodel.net_blobs(solver.net, state.params, state.stats)
-    caffemodel.save_weights(blobs, model_path, net_name=solver.net.name or "net")
     leaves, _ = _flatten_history(jax.device_get(state.history))
-    np.savez(
-        state_path,
-        iter=np.asarray(it, np.int64),
-        **{f"h{i}": np.asarray(l) for i, l in enumerate(leaves)},
-    )
+    if fmt == "HDF5":
+        from sparknet_tpu.io import hdf5
+
+        model_path = f"{prefix}_iter_{it}.caffemodel.h5"
+        state_path = f"{prefix}_iter_{it}.solverstate.h5"
+        hdf5.save_weights_hdf5(blobs, model_path)
+        hdf5.save_state_hdf5(state_path, it, [np.asarray(l) for l in leaves])
+    else:
+        model_path = f"{prefix}_iter_{it}.caffemodel"
+        state_path = f"{prefix}_iter_{it}.solverstate.npz"
+        caffemodel.save_weights(
+            blobs, model_path, net_name=solver.net.name or "net"
+        )
+        np.savez(
+            state_path,
+            iter=np.asarray(it, np.int64),
+            **{f"h{i}": np.asarray(l) for i, l in enumerate(leaves)},
+        )
     return model_path, state_path
+
+
+def _load_model_blobs(model_path: str):
+    if model_path.endswith(".h5"):
+        from sparknet_tpu.io import hdf5
+
+        return hdf5.load_weights_hdf5(model_path)
+    return caffemodel.load_weights(model_path)
 
 
 def restore(solver: Solver, prefix_or_state_path: str, seed: int = 0) -> TrainState:
     """Rebuild a TrainState from a snapshot (``Solver::Restore`` +
-    ``restore_solver_from_file``, ccaffe.cpp:271-273)."""
+    ``restore_solver_from_file``, ccaffe.cpp:271-273).  Accepts either a
+    ``.solverstate.npz`` or ``.solverstate.h5`` path."""
     state_path = prefix_or_state_path
-    if not state_path.endswith(".solverstate.npz"):
-        raise ValueError("pass the .solverstate.npz path")
-    model_path = state_path[: -len(".solverstate.npz")] + ".caffemodel"
     fresh = solver.init_state(seed)
-    loaded = caffemodel.load_weights(model_path)
+    leaves, treedef = _flatten_history(jax.device_get(fresh.history))
+    if state_path.endswith(".solverstate.h5"):
+        from sparknet_tpu.io import hdf5
+
+        model_path = state_path[: -len(".solverstate.h5")] + ".caffemodel.h5"
+        it, _step, new_leaves = hdf5.load_state_hdf5(state_path)
+        if len(new_leaves) != len(leaves):
+            raise ValueError(
+                f"{state_path}: {len(new_leaves)} history blobs, solver "
+                f"has {len(leaves)}"
+            )
+    elif state_path.endswith(".solverstate.npz"):
+        model_path = state_path[: -len(".solverstate.npz")] + ".caffemodel"
+        with np.load(state_path) as z:
+            it = int(z["iter"])
+            new_leaves = [z[f"h{i}"] for i in range(len(leaves))]
+    else:
+        raise ValueError("pass a .solverstate.npz or .solverstate.h5 path")
+    loaded = _load_model_blobs(model_path)
     params, stats = caffemodel.apply_blobs(
         solver.net, jax.device_get(fresh.params), jax.device_get(fresh.stats), loaded
     )
-    with np.load(state_path) as z:
-        it = int(z["iter"])
-        leaves, treedef = _flatten_history(jax.device_get(fresh.history))
-        new_leaves = [z[f"h{i}"] for i in range(len(leaves))]
-        history = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    history = jax.tree_util.tree_unflatten(treedef, new_leaves)
     return TrainState(
         params=jax.device_put(params),
         stats=jax.device_put(stats),
@@ -73,12 +111,12 @@ def restore(solver: Solver, prefix_or_state_path: str, seed: int = 0) -> TrainSt
 
 
 def load_weights_into_state(
-    solver: Solver, state: TrainState, caffemodel_path: str
+    solver: Solver, state: TrainState, model_path: str
 ) -> TrainState:
-    """Warm start from a .caffemodel only (the ``--weights=`` /
-    ``loadWeightsFromFile`` path, Net.scala:238-240): history and iter keep
-    their current values."""
-    loaded = caffemodel.load_weights(caffemodel_path)
+    """Warm start from a .caffemodel or .caffemodel.h5 only (the
+    ``--weights=`` / ``loadWeightsFromFile`` path, Net.scala:238-240):
+    history and iter keep their current values."""
+    loaded = _load_model_blobs(model_path)
     params, stats = caffemodel.apply_blobs(
         solver.net, jax.device_get(state.params), jax.device_get(state.stats), loaded
     )
