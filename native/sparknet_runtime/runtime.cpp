@@ -303,10 +303,14 @@ class Pipeline {
         }
         idx = (idx + 1) % n;  // epoch wrap, deterministic order like the
                               // reference's sequential cursor
-        if (value.size() != record_bytes) {
+        // Datum records carry a 1-byte label (<=255 classes) or a
+        // 2-byte little-endian one (1000-class ImageNet); the width is
+        // record length minus the known image size.
+        if (value.size() != record_bytes && value.size() != record_bytes + 1) {
           SetError("record size mismatch: got " +
                    std::to_string(value.size()) + ", want " +
-                   std::to_string(record_bytes));
+                   std::to_string(record_bytes) + " or " +
+                   std::to_string(record_bytes + 1));
           stop_.store(true);
           break;
         }
@@ -323,8 +327,12 @@ class Pipeline {
   // mirror (train only), mean subtraction, scale.
   void Transform(const std::string& value, float* out, float* label) {
     const uint8_t* bytes = reinterpret_cast<const uint8_t*>(value.data());
-    *label = static_cast<float>(bytes[0]);
-    const uint8_t* img = bytes + 1;
+    const size_t label_w =
+        value.size() - size_t(cfg_.c) * cfg_.h * cfg_.w;  // 1 or 2
+    *label = static_cast<float>(
+        label_w == 2 ? (unsigned(bytes[0]) | (unsigned(bytes[1]) << 8))
+                     : bytes[0]);
+    const uint8_t* img = bytes + label_w;
     int h_off = 0, w_off = 0;
     if (cfg_.crop > 0) {
       if (cfg_.train) {

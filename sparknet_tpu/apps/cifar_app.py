@@ -38,27 +38,40 @@ def main(argv=None) -> int:
     import jax
 
     from sparknet_tpu import models
+    from sparknet_tpu.apps.scores import primary_accuracy
     from sparknet_tpu.data import CifarLoader, MinibatchSampler
     from sparknet_tpu.parallel import (
         ParameterAveragingTrainer,
+        local_worker_slice,
         make_mesh,
-        shard_leading,
+        shard_leading_global,
     )
     from sparknet_tpu.solver import Solver
     from sparknet_tpu.utils import TrainingLog
 
-    log = TrainingLog(tag="cifar")
+    distributed = jax.process_count() > 1
+    log = TrainingLog(tag="cifar", echo=jax.process_index() == 0)
     data_dir = args.data
     if data_dir is None:
         data_dir = tempfile.mkdtemp(prefix="cifar_synth_")
         CifarLoader.write_synthetic(data_dir, num_train=5000, num_test=1000)
         log.log(f"synthesized CIFAR-format data in {data_dir}")
 
-    n_workers = args.workers or jax.local_device_count()
+    n_workers = args.workers or (
+        jax.device_count() if distributed else jax.local_device_count()
+    )
+    if distributed and n_workers != jax.device_count():
+        raise SystemExit("multi-host runs must use --workers == all devices")
     log.log(f"num workers: {n_workers}")
 
     loader = CifarLoader(data_dir, seed=args.seed)
     log.log("loaded data")
+
+    mesh = make_mesh({"dp": n_workers}, devices=jax.devices()[:n_workers])
+    # this host's contiguous block of workers (every host computes the
+    # same global partitioning, then keeps only its own — the Spark
+    # partitions-per-executor analog)
+    mine = local_worker_slice(mesh) if distributed else slice(0, n_workers)
 
     x, y = loader.minibatches(args.batch, train=True)
     if len(x) < n_workers * args.tau:
@@ -77,6 +90,7 @@ def main(argv=None) -> int:
         for w, (xs, ys) in enumerate(
             zip(np.array_split(x, n_workers), np.array_split(y, n_workers))
         )
+        if mine.start <= w < mine.stop
     ]
     xt, yt = loader.minibatches(args.batch, train=False)
     # heterogeneous test partitions (Spark parallelize gives near-equal
@@ -89,31 +103,39 @@ def main(argv=None) -> int:
     ]
     num_test_batches = len(xt)
 
-    mesh = make_mesh({"dp": n_workers}, devices=jax.devices()[:n_workers])
     solver = Solver(models.load_model_solver("cifar10_full"))
     trainer = ParameterAveragingTrainer(solver, mesh)
     state = trainer.init_state(seed=args.seed)
     test_batches, test_counts = ParameterAveragingTrainer.pad_partitions(
         test_parts
     )
-    test_on_dev = shard_leading(test_batches, mesh)
+    test_on_dev = shard_leading_global(
+        {k: v[mine] for k, v in test_batches.items()}
+        if distributed
+        else test_batches,
+        mesh,
+    )
     log.log("finished setting up nets and weights")
+
+    def evaluate(r=None):
+        scores = trainer.test_and_store_result(
+            state, test_on_dev, counts=test_counts
+        )
+        for name in sorted(scores):
+            log.log(f"test output {name} = {scores[name] / num_test_batches:.4f}")
+        return primary_accuracy(scores) / num_test_batches
 
     for r in range(args.rounds):
         if r % args.test_every == 0:  # test before train, CifarApp.scala:101
-            scores = trainer.test_and_store_result(state, test_on_dev, counts=test_counts)
-            acc = scores.get("accuracy", 0.0) / num_test_batches
-            log.log(f"round {r}, accuracy {acc:.4f}")
+            log.log(f"round {r}, accuracy {evaluate(r):.4f}")
         windows = [s.next_window() for s in samplers]
         stacked = {
             k: np.stack([w[k] for w in windows]) for k in windows[0]
         }
-        state, _ = trainer.round(state, shard_leading(stacked, mesh))
+        state, _ = trainer.round(state, shard_leading_global(stacked, mesh))
         log.log(f"round {r} trained, smoothed_loss {solver.smoothed_loss:.4f}")
 
-    scores = trainer.test_and_store_result(state, test_on_dev, counts=test_counts)
-    acc = scores.get("accuracy", 0.0) / num_test_batches
-    log.log(f"final accuracy {acc:.4f}")
+    log.log(f"final accuracy {evaluate():.4f}")
     return 0
 
 

@@ -37,17 +37,22 @@ CROP_SIZE = 227  # croppedHeight/croppedWidth, ImageNetApp.scala:25-26
 
 def load_minibatch_partitions(
     loader, prefix: str, labels_file: str, n_workers: int, batch: int,
-    height: int, width: int,
+    height: int, width: int, keep: slice = slice(None),
 ):
     """Partition shards over workers and pack each partition into uint8
     minibatches (materialized — performance is best if the data fits in
-    memory, same caveat as the reference app's .persist())."""
+    memory, same caveat as the reference app's .persist()).  ``keep``
+    selects which workers' partitions to materialize — a multi-host run
+    loads only its own block while every host agrees on the global
+    partitioning."""
     from sparknet_tpu.data import ScaleAndConvert
 
     conv = ScaleAndConvert(batch, height, width)
     parts = loader.partitions(prefix, labels_file, num_parts=n_workers)
     out = []
-    for part in parts:
+    for w, part in enumerate(parts):
+        if keep != slice(None) and not (keep.start <= w < keep.stop):
+            continue
         mbs = list(conv.make_minibatches(part))
         out.append(mbs)
     return out
@@ -87,16 +92,19 @@ def main(argv=None) -> int:
         transforms,
         write_synthetic_imagenet,
     )
+    from sparknet_tpu.apps.scores import primary_accuracy
     from sparknet_tpu.io.caffemodel import save_mean_image
     from sparknet_tpu.parallel import (
         ParameterAveragingTrainer,
+        local_worker_slice,
         make_mesh,
-        shard_leading,
+        shard_leading_global,
     )
     from sparknet_tpu.solver import Solver
     from sparknet_tpu.utils import TrainingLog
 
-    log = TrainingLog(tag="imagenet")
+    distributed = jax.process_count() > 1
+    log = TrainingLog(tag="imagenet", echo=jax.process_index() == 0)
     synthetic = args.data is None
     if synthetic:
         # scaled-down defaults so the offline demo fits one host
@@ -128,23 +136,38 @@ def main(argv=None) -> int:
         args.crop = args.crop or CROP_SIZE
         data_dir = args.data
 
-    n_workers = args.workers or jax.local_device_count()
+    n_workers = args.workers or (
+        jax.device_count() if distributed else jax.local_device_count()
+    )
+    if distributed and n_workers != jax.device_count():
+        raise SystemExit("multi-host runs must use --workers == all devices")
     log.log(f"num workers: {n_workers}")
+
+    mesh = make_mesh({"dp": n_workers}, devices=jax.devices()[:n_workers])
+    mine = local_worker_slice(mesh) if distributed else slice(0, n_workers)
 
     loader = ImageNetLoader(data_dir)
     log.log("loading train data")
     train_parts = load_minibatch_partitions(
         loader, args.train_prefix, args.train_labels, n_workers,
-        args.train_batch, args.full_size, args.full_size,
+        args.train_batch, args.full_size, args.full_size, keep=mine,
     )
-    num_train_mbs = sum(len(p) for p in train_parts)
-    log.log(f"numTrainMinibatches = {num_train_mbs}")
     log.log("loading test data")
     test_parts = load_minibatch_partitions(
         loader, args.test_prefix, args.test_labels, n_workers,
-        args.test_batch, args.full_size, args.full_size,
+        args.test_batch, args.full_size, args.full_size, keep=mine,
     )
-    num_test_mbs = sum(len(p) for p in test_parts)
+
+    def global_sum(n: int) -> int:
+        if not distributed:
+            return n
+        from jax.experimental import multihost_utils
+
+        return int(multihost_utils.process_allgather(np.int64(n)).sum())
+
+    num_train_mbs = global_sum(sum(len(p) for p in train_parts))
+    log.log(f"numTrainMinibatches = {num_train_mbs}")
+    num_test_mbs = global_sum(sum(len(p) for p in test_parts))
     log.log(f"numTestMinibatches = {num_test_mbs}")
     if min(len(p) for p in train_parts) < args.tau:
         raise SystemExit(
@@ -159,15 +182,36 @@ def main(argv=None) -> int:
         )
 
     log.log("computing mean image")
-    mean = reduce_mean_sums(
-        [compute_mean(iter(p), return_sum=True) for p in train_parts]
-    )
+    local_sums = [compute_mean(iter(p), return_sum=True) for p in train_parts]
+    if distributed:
+        # cross-host ComputeMean reduce: allgather every host's (sum,
+        # count) partial (one image-sized accumulator per host).  The int64
+        # sums ride as hi/lo int32 halves — allgather demotes int64 when
+        # x64 is off, and count*255 can exceed int32 on big corpora.
+        from jax.experimental import multihost_utils
+
+        total = sum(s for s, _ in local_sums)
+        count = sum(c for _, c in local_sums)
+        hi = (total >> 20).astype(np.int32)
+        lo = (total & ((1 << 20) - 1)).astype(np.int32)
+        g_hi, g_lo, g_cnt = multihost_utils.process_allgather(
+            (hi, lo, np.int32(count))
+        )
+        host_totals = (np.asarray(g_hi, np.int64) << 20) + np.asarray(
+            g_lo, np.int64
+        )
+        mean = reduce_mean_sums(
+            [(t, int(c)) for t, c in zip(host_totals, np.asarray(g_cnt))]
+        )
+    else:
+        mean = reduce_mean_sums(local_sums)
     mean_path = os.path.join(data_dir, "mean.binaryproto")
     save_mean_image(mean, mean_path)
     log.log(f"mean image -> {mean_path}")
 
     # per-worker samplers over that worker's partition (contiguous random
-    # window of tau per round, MinibatchSampler semantics)
+    # window of tau per round, MinibatchSampler semantics); seeds keyed by
+    # GLOBAL worker index so a multi-host run draws like a 1-host run
     samplers = [
         MinibatchSampler(
             {
@@ -175,9 +219,9 @@ def main(argv=None) -> int:
                 "label": np.stack([mb[1].astype(np.float32) for mb in part]),
             },
             num_sampled_batches=args.tau,
-            seed=args.seed + w,
+            seed=args.seed + mine.start + i,
         )
-        for w, part in enumerate(train_parts)
+        for i, part in enumerate(train_parts)
     ]
     # test batches: heterogeneous per-worker counts, pad-and-mask — every
     # minibatch is scored even when val shards split unevenly
@@ -190,7 +234,22 @@ def main(argv=None) -> int:
             for p in test_parts
         ]
     )
-    num_test_used = int(test_counts.sum())
+    if distributed:
+        # agree globally on the pad length and counts vector
+        from jax.experimental import multihost_utils
+
+        g_counts = multihost_utils.process_allgather(
+            np.asarray(test_counts, np.int32)
+        ).reshape(-1)
+        nb_max = int(g_counts.max())
+        if nb_max > test_batches["data"].shape[1]:
+            pad = nb_max - test_batches["data"].shape[1]
+            test_batches = {
+                k: np.pad(v, [(0, 0), (0, pad)] + [(0, 0)] * (v.ndim - 2))
+                for k, v in test_batches.items()
+            }
+        test_counts = g_counts
+    num_test_used = int(np.asarray(test_counts).sum())
     del train_parts, test_parts  # samplers/test_batches hold the only copy
 
     # net: cropped feed shapes (replaceDataLayers, ImageNetApp.scala:103-104)
@@ -212,29 +271,37 @@ def main(argv=None) -> int:
         test_transform=transforms.test_transform(mean, args.crop),
     )
 
-    mesh = make_mesh({"dp": n_workers}, devices=jax.devices()[:n_workers])
     trainer = ParameterAveragingTrainer(solver, mesh)
     state = trainer.init_state(seed=args.seed)
-    test_on_dev = shard_leading(test_batches, mesh)
+    test_on_dev = shard_leading_global(test_batches, mesh)
     log.log("finished setting up nets and weights")
+
+    def evaluate(r=-1):
+        scores = trainer.test_and_store_result(
+            state, test_on_dev, counts=test_counts
+        )
+        for name in sorted(scores):  # solver.cpp:397-410 logs every output
+            log.log(
+                f"test output {name} = {scores[name] / max(1, num_test_used):.4f}",
+                i=r,
+            )
+        return primary_accuracy(scores) / max(1, num_test_used)
 
     for r in range(args.rounds):
         if r % args.test_every == 0:  # test-then-train, ImageNetApp.scala:118
-            scores = trainer.test_and_store_result(state, test_on_dev, counts=test_counts)
-            acc = scores.get("accuracy", 0.0) / max(1, num_test_used)
-            log.log(f"{acc * 100:.2f}% accuracy", i=r)
+            log.log(f"{evaluate(r) * 100:.2f}% accuracy", i=r)
         log.log("training", i=r)
         windows = [s.next_window() for s in samplers]
         stacked = {k: np.stack([w[k] for w in windows]) for k in windows[0]}
-        state, _ = trainer.round(state, shard_leading(stacked, mesh))
+        state, _ = trainer.round(state, shard_leading_global(stacked, mesh))
         log.log(
             f"trained, smoothed_loss {solver.smoothed_loss:.4f}", i=r
         )
 
-    scores = trainer.test_and_store_result(state, test_on_dev, counts=test_counts)
-    acc = scores.get("accuracy", 0.0) / max(1, num_test_used)
+    acc = evaluate()
     log.log(f"final accuracy {acc * 100:.2f}%")
-    print(f"final accuracy {acc * 100:.2f}%")
+    if jax.process_index() == 0:
+        print(f"final accuracy {acc * 100:.2f}%")
     return 0
 
 

@@ -1,0 +1,152 @@
+"""ImageNetCreateDBApp — phase 1 of the two-phase ImageNet DB path.
+
+Reference: ``src/main/scala/apps/ImageNetCreateDBApp.scala:60-133`` —
+load the tar shards, ScaleAndConvert to full-size uint8 minibatches,
+coalesce to one partition per worker, write per-worker train/test
+LevelDBs through the shim, record per-worker test batch counts in an
+infoFile, and compute + save the mean image.  TPU-native deltas: the DBs
+are the native runtime's record format (``runtime.write_datum_db``;
+LMDB *reading* compat lives in ``io/lmdb.py``), images are stored
+full-size so phase 2 can crop on device, and the infoFile holds every
+worker's count (the reference's one-file-per-worker overwrite pattern
+kept only the last).
+
+Run:
+    python -m sparknet_tpu.apps.imagenet_create_db_app --data=DIR \
+        --out=DB_DIR --workers=4
+(synthesizes JPEG tar shards when --data is omitted)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+
+import numpy as np
+
+FULL_SIZE = 256  # fullHeight/fullWidth (ImageNetCreateDBApp.scala:26-27)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--data", default=None,
+                        help="dir with tar shards + train.txt/val.txt")
+    parser.add_argument("--out", default=None, help="output DB dir")
+    parser.add_argument("--train_prefix", default="train.")
+    parser.add_argument("--test_prefix", default="val.")
+    parser.add_argument("--train_labels", default="train.txt")
+    parser.add_argument("--test_labels", default="val.txt")
+    parser.add_argument("--workers", type=int, default=2)
+    parser.add_argument("--train_batch", type=int, default=0)
+    parser.add_argument("--test_batch", type=int, default=0)
+    parser.add_argument("--full_size", type=int, default=0)
+    parser.add_argument("--classes", type=int, default=1000)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    from sparknet_tpu import runtime
+    from sparknet_tpu.apps.imagenet_app import load_minibatch_partitions
+    from sparknet_tpu.data import (
+        ImageNetLoader,
+        compute_mean,
+        reduce_mean_sums,
+        write_synthetic_imagenet,
+    )
+    from sparknet_tpu.io.caffemodel import save_mean_image
+    from sparknet_tpu.utils import TrainingLog
+
+    log = TrainingLog(tag="imagenet_create_db")
+    synthetic = args.data is None
+    if synthetic:
+        args.train_batch = args.train_batch or 8
+        args.test_batch = args.test_batch or 4
+        args.full_size = args.full_size or 64
+        args.classes = min(args.classes, 4)
+        data_dir = tempfile.mkdtemp(prefix="imagenet_synth_")
+        write_synthetic_imagenet(
+            data_dir, num_shards=max(2, args.workers),
+            images_per_shard=args.train_batch * 6, classes=args.classes,
+            seed=args.seed,
+        )
+        write_synthetic_imagenet(
+            data_dir, num_shards=max(2, args.workers),
+            images_per_shard=args.test_batch * 2, classes=args.classes,
+            labels_file="val.txt", shard_prefix="val.", seed=args.seed + 1,
+        )
+        log.log(f"synthesized JPEG tar shards in {data_dir}")
+    else:
+        args.train_batch = args.train_batch or 256
+        args.test_batch = args.test_batch or 50
+        args.full_size = args.full_size or FULL_SIZE
+        data_dir = args.data
+
+    out_dir = args.out or tempfile.mkdtemp(prefix="imagenet_dbs_")
+    os.makedirs(out_dir, exist_ok=True)
+
+    loader = ImageNetLoader(data_dir)
+    log.log("processing train data")
+    train_parts = load_minibatch_partitions(
+        loader, args.train_prefix, args.train_labels, args.workers,
+        args.train_batch, args.full_size, args.full_size,
+    )
+    num_train_mbs = sum(len(p) for p in train_parts)
+    log.log(f"numTrainMinibatches = {num_train_mbs}")
+    log.log("processing test data")
+    test_parts = load_minibatch_partitions(
+        loader, args.test_prefix, args.test_labels, args.workers,
+        args.test_batch, args.full_size, args.full_size,
+    )
+    num_test_mbs = sum(len(p) for p in test_parts)
+    log.log(f"numTestMinibatches = {num_test_mbs}")
+    log.log(f"trainPartitionSizes = {[len(p) for p in train_parts]}")
+    log.log(f"testPartitionSizes = {[len(p) for p in test_parts]}")
+
+    log.log("write train data to DB")
+    for w, part in enumerate(train_parts):
+        path = os.path.join(out_dir, f"ilsvrc12_train_db_{w}.sndb")
+        runtime.write_datum_db(
+            path,
+            np.concatenate([mb[0] for mb in part]),
+            np.concatenate([mb[1] for mb in part]),
+        )
+    log.log("write test data to DB")
+    for w, part in enumerate(test_parts):
+        path = os.path.join(out_dir, f"ilsvrc12_val_db_{w}.sndb")
+        runtime.write_datum_db(
+            path,
+            np.concatenate([mb[0] for mb in part]),
+            np.concatenate([mb[1] for mb in part]),
+        )
+
+    # infoFile (imagenet_num_test_batches.txt role): per-worker test
+    # batch counts + the shapes phase 2 needs
+    info = {
+        "workers": args.workers,
+        "full_size": args.full_size,
+        "classes": args.classes,
+        "train_batch": args.train_batch,
+        "test_batch": args.test_batch,
+        "train_batches": [len(p) for p in train_parts],
+        "test_batches": [len(p) for p in test_parts],
+    }
+    info_path = os.path.join(out_dir, "imagenet_db_info.json")
+    with open(info_path, "w") as f:
+        json.dump(info, f, indent=1)
+    log.log(f"infoFile -> {info_path}")
+
+    log.log("computing mean image")
+    mean = reduce_mean_sums(
+        [compute_mean(iter(p), return_sum=True) for p in train_parts]
+    )
+    mean_path = os.path.join(out_dir, "imagenet_mean.binaryproto")
+    save_mean_image(mean, mean_path)
+    log.log(f"mean image -> {mean_path}")
+    log.log("finished creating databases")
+    print(out_dir)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

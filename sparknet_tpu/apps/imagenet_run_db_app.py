@@ -1,0 +1,213 @@
+"""ImageNetRunDBApp — phase 2 of the two-phase ImageNet DB path.
+
+Reference: ``src/main/scala/apps/ImageNetRunDBApp.scala:40-117`` — read
+the infoFile for per-worker test batch counts, build per-worker solvers
+whose engine ``DataLayer`` reads the DBs, **warm-start from a
+.caffemodel** (``net.loadWeightsFromFile``, ``:72-77``), then the
+τ=50 averaging loop testing every 10 rounds.  The reference's periodic
+weight save (commented out at ``:95-100``) is wired in here for real:
+``--snapshot_every N`` writes model+solver state through
+``io/checkpoint.py`` and ``--resume`` continues from the newest one —
+kill -> resume -> eval is a tested path (tests/test_db_apps.py).
+
+Run:
+    python -m sparknet_tpu.apps.imagenet_run_db_app --db_dir=DB_DIR \
+        --rounds=20 --warm_start=weights.caffemodel
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+
+import numpy as np
+
+TAU = 50  # syncInterval, ImageNetRunDBApp.scala:104
+
+
+def _broadcast_state(trainer, st):
+    """Restore semantics: every worker restarts from the snapshot file,
+    exactly like the reference restoring the same .solverstate on each
+    executor."""
+    import jax
+    from sparknet_tpu.parallel import shard_leading
+
+    n = trainer.num_workers
+    stacked = jax.tree_util.tree_map(
+        lambda x: np.broadcast_to(
+            np.asarray(x), (n,) + np.asarray(x).shape
+        ).copy(),
+        jax.device_get(st),
+    )
+    return shard_leading(stacked, trainer.mesh)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--db_dir", required=True)
+    parser.add_argument("--model", default="caffenet")
+    parser.add_argument("--rounds", type=int, default=10)
+    parser.add_argument("--tau", type=int, default=0, help="0 = reference (50)")
+    parser.add_argument("--test_every", type=int, default=10)
+    parser.add_argument("--crop", type=int, default=0)
+    parser.add_argument("--no_mirror", action="store_true")
+    parser.add_argument("--warm_start", default=None,
+                        help=".caffemodel[.h5] to load weights from")
+    parser.add_argument("--snapshot_every", type=int, default=0,
+                        help="snapshot every N rounds")
+    parser.add_argument("--snapshot_prefix", default=None)
+    parser.add_argument("--resume", action="store_true",
+                        help="continue from the newest snapshot")
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    import jax
+
+    from sparknet_tpu import config as cfg, models, runtime
+    from sparknet_tpu.apps.scores import primary_accuracy
+    from sparknet_tpu.io import caffemodel, checkpoint
+    from sparknet_tpu.parallel import (
+        ParameterAveragingTrainer,
+        first_worker,
+        make_mesh,
+        shard_leading,
+    )
+    from sparknet_tpu.solver import Solver
+    from sparknet_tpu.utils import TrainingLog
+
+    log = TrainingLog(tag="imagenet_run_db")
+    info_path = os.path.join(args.db_dir, "imagenet_db_info.json")
+    with open(info_path) as f:
+        info = json.load(f)
+    n_workers = int(info["workers"])
+    full = int(info["full_size"])
+    args.tau = args.tau or TAU
+    crop = args.crop or (227 if full >= 256 else (full * 7) // 8)
+    log.log(f"testPartitionSizes = {info['test_batches']}")
+    num_test_mbs = int(sum(info["test_batches"]))
+
+    mean = caffemodel.load_mean_image(
+        os.path.join(args.db_dir, "imagenet_mean.binaryproto")
+    )
+
+    # per-worker native pipelines: train crops randomly + mirrors, test
+    # center-crops — DataTransformer semantics in the reader thread
+    pipes = [
+        runtime.DataPipeline(
+            os.path.join(args.db_dir, f"ilsvrc12_train_db_{w}.sndb"),
+            batch_size=int(info["train_batch"]),
+            shape=(3, full, full),
+            crop=crop,
+            mirror=not args.no_mirror,
+            train=True,
+            mean=mean,
+            seed=args.seed + w,
+        )
+        for w in range(n_workers)
+    ]
+    test_pipes = [
+        runtime.DataPipeline(
+            os.path.join(args.db_dir, f"ilsvrc12_val_db_{w}.sndb"),
+            batch_size=int(info["test_batch"]),
+            shape=(3, full, full),
+            crop=crop,
+            train=False,
+            mean=mean,
+            seed=args.seed,
+        )
+        for w in range(n_workers)
+    ]
+
+    netp = models.load_model(args.model) if args.model in (
+        "alexnet",
+    ) else models.load_model(args.model, classes=int(info["classes"]))
+    netp = cfg.replace_data_layers(
+        netp,
+        [(int(info["train_batch"]), 3, crop, crop), (int(info["train_batch"]),)],
+        [(int(info["test_batch"]), 3, crop, crop), (int(info["test_batch"]),)],
+    )
+    solver = Solver(models.load_model_solver(args.model), net_param=netp)
+    mesh = make_mesh({"dp": n_workers}, devices=jax.devices()[:n_workers])
+    trainer = ParameterAveragingTrainer(solver, mesh)
+    state = trainer.init_state(seed=args.seed)
+
+    prefix = args.snapshot_prefix or os.path.join(args.db_dir, "imagenet_db")
+    start_round = 0
+    if args.resume:
+        states = sorted(
+            glob.glob(prefix + "_iter_*.solverstate*"),
+            key=lambda p: int(p.split("_iter_")[1].split(".")[0]),
+        )
+        if not states:
+            raise SystemExit(f"--resume: no {prefix}_iter_*.solverstate*")
+        st = checkpoint.restore(solver, states[-1])
+        state = _broadcast_state(trainer, st)
+        start_round = int(np.asarray(st.iter)) // args.tau
+        log.log(f"resumed from {states[-1]} at round {start_round}")
+    elif args.warm_start:
+        # ImageNetRunDBApp.scala:75 loadWeightsFromFile
+        st = checkpoint.load_weights_into_state(
+            solver, first_worker(jax.device_get(state)), args.warm_start
+        )
+        state = _broadcast_state(trainer, st)
+        log.log(f"warm start from {args.warm_start}")
+    log.log("initialize nets on workers")
+
+    # pad-and-mask heterogeneous test partitions from the infoFile
+    counts = np.asarray(info["test_batches"], np.int32)
+    nb_max = int(counts.max())
+    tb = {
+        "data": np.zeros(
+            (n_workers, nb_max, int(info["test_batch"]), 3, crop, crop),
+            np.float32,
+        ),
+        "label": np.zeros(
+            (n_workers, nb_max, int(info["test_batch"])), np.float32
+        ),
+    }
+    for w, pipe in enumerate(test_pipes):
+        for b in range(int(counts[w])):
+            x, y = pipe.next()
+            tb["data"][w, b] = x
+            tb["label"][w, b] = y
+    test_on_dev = shard_leading(tb, mesh)
+
+    def evaluate():
+        scores = trainer.test_and_store_result(
+            state, test_on_dev, counts=counts
+        )
+        return primary_accuracy(scores) / max(1, num_test_mbs)
+
+    for r in range(start_round, start_round + args.rounds):
+        if r % args.test_every == 0:
+            log.log(f"{evaluate() * 100:.2f}% accuracy", i=r)
+        log.log("training", i=r)
+        windows = []
+        for pipe in pipes:
+            batches = [pipe.next() for _ in range(args.tau)]
+            windows.append(
+                {
+                    "data": np.stack([b[0] for b in batches]),
+                    "label": np.stack([b[1] for b in batches]),
+                }
+            )
+        stacked = {k: np.stack([w[k] for w in windows]) for k in windows[0]}
+        state, _ = trainer.round(state, shard_leading(stacked, mesh))
+        log.log(f"trained, smoothed_loss {solver.smoothed_loss:.4f}", i=r)
+        if args.snapshot_every and (r + 1) % args.snapshot_every == 0:
+            st = first_worker(jax.device_get(state))
+            model_path, state_path = checkpoint.snapshot(solver, st, prefix)
+            log.log(f"snapshot -> {model_path}", i=r)
+
+    acc = evaluate()
+    log.log(f"final accuracy {acc * 100:.2f}%")
+    print(f"final accuracy {acc * 100:.2f}%")
+    for p in pipes + test_pipes:
+        p.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -312,9 +312,139 @@ def _merge_dict(dst: Dict[str, List[Any]], src: Dict[str, List[Any]]) -> None:
         dst.setdefault(k, []).extend(vs)
 
 
+# ---------------------------------------------------------------------------
+# V0 upgrade (reference: upgrade_proto.cpp:96-529 UpgradeV0Net /
+# UpgradeV0LayerParameter / UpgradeV0LayerType).  A V0 net wraps each
+# layer in a connection: ``layers { layer { <flat fields> } bottom top }``
+# with lowercase short type names and per-type flat fields; the upgrade
+# routes each flat field into the modern per-type sub-message.  Runs on
+# the raw token dicts (before schema binding, where the V0-only fields
+# would be unknown); the V1 leg (_upgrade_net) then finishes blobs_lr ->
+# ParamSpec.
+# ---------------------------------------------------------------------------
+
+_V0_LAYER_TYPES = {
+    "accuracy": "Accuracy", "bnll": "BNLL", "concat": "Concat",
+    "conv": "Convolution", "data": "Data", "dropout": "Dropout",
+    "euclidean_loss": "EuclideanLoss", "flatten": "Flatten",
+    "hdf5_data": "HDF5Data", "hdf5_output": "HDF5Output",
+    "im2col": "Im2col", "images": "ImageData",
+    "infogain_loss": "InfogainLoss", "innerproduct": "InnerProduct",
+    "lrn": "LRN", "multinomial_logistic_loss": "MultinomialLogisticLoss",
+    "pool": "Pooling", "relu": "ReLU", "sigmoid": "Sigmoid",
+    "softmax": "Softmax", "softmax_loss": "SoftmaxWithLoss",
+    "split": "Split", "tanh": "TanH", "window_data": "WindowData",
+}
+
+# (v0_field, v0_type) -> (sub_message, field) routing; None sub = layer
+# level.  Mirrors the if-ladders of UpgradeV0LayerParameter.
+_V0_ROUTES = {
+    ("num_output", "conv"): ("convolution_param", "num_output"),
+    ("num_output", "innerproduct"): ("inner_product_param", "num_output"),
+    ("biasterm", "conv"): ("convolution_param", "bias_term"),
+    ("biasterm", "innerproduct"): ("inner_product_param", "bias_term"),
+    ("weight_filler", "conv"): ("convolution_param", "weight_filler"),
+    ("weight_filler", "innerproduct"): ("inner_product_param", "weight_filler"),
+    ("bias_filler", "conv"): ("convolution_param", "bias_filler"),
+    ("bias_filler", "innerproduct"): ("inner_product_param", "bias_filler"),
+    ("pad", "conv"): ("convolution_param", "pad"),
+    ("pad", "pool"): ("pooling_param", "pad"),
+    ("kernelsize", "conv"): ("convolution_param", "kernel_size"),
+    ("kernelsize", "pool"): ("pooling_param", "kernel_size"),
+    ("group", "conv"): ("convolution_param", "group"),
+    ("stride", "conv"): ("convolution_param", "stride"),
+    ("stride", "pool"): ("pooling_param", "stride"),
+    ("pool", "pool"): ("pooling_param", "pool"),
+    ("dropout_ratio", "dropout"): ("dropout_param", "dropout_ratio"),
+    ("local_size", "lrn"): ("lrn_param", "local_size"),
+    ("alpha", "lrn"): ("lrn_param", "alpha"),
+    ("beta", "lrn"): ("lrn_param", "beta"),
+    ("k", "lrn"): ("lrn_param", "k"),
+    ("source", "data"): ("data_param", "source"),
+    ("source", "hdf5_data"): ("hdf5_data_param", "source"),
+    ("source", "images"): ("image_data_param", "source"),
+    ("source", "window_data"): ("window_data_param", "source"),
+    ("source", "infogain_loss"): ("infogain_loss_param", "source"),
+    ("batchsize", "data"): ("data_param", "batch_size"),
+    ("batchsize", "hdf5_data"): ("hdf5_data_param", "batch_size"),
+    ("batchsize", "images"): ("image_data_param", "batch_size"),
+    ("batchsize", "window_data"): ("window_data_param", "batch_size"),
+    ("rand_skip", "data"): ("data_param", "rand_skip"),
+    ("rand_skip", "images"): ("image_data_param", "rand_skip"),
+    ("shuffle_images", "images"): ("image_data_param", "shuffle"),
+    ("new_height", "images"): ("image_data_param", "new_height"),
+    ("new_width", "images"): ("image_data_param", "new_width"),
+    ("concat_dim", "concat"): ("concat_param", "concat_dim"),
+    # data transformations (UpgradeNetDataTransformation folds these into
+    # transform_param for every data-ish type)
+    ("scale", "data"): ("transform_param", "scale"),
+    ("scale", "images"): ("transform_param", "scale"),
+    ("scale", "window_data"): ("transform_param", "scale"),
+    ("meanfile", "data"): ("transform_param", "mean_file"),
+    ("meanfile", "images"): ("transform_param", "mean_file"),
+    ("meanfile", "window_data"): ("transform_param", "mean_file"),
+    ("cropsize", "data"): ("transform_param", "crop_size"),
+    ("cropsize", "images"): ("transform_param", "crop_size"),
+    ("cropsize", "window_data"): ("transform_param", "crop_size"),
+    ("mirror", "data"): ("transform_param", "mirror"),
+    ("mirror", "images"): ("transform_param", "mirror"),
+    ("mirror", "window_data"): ("transform_param", "mirror"),
+}
+
+
+def _upgrade_v0_entry(entry: Dict[str, List[Any]]) -> Dict[str, List[Any]]:
+    """One V0 ``layers { layer {...} bottom top }`` connection -> a modern
+    layer token dict."""
+    inner = entry["layer"][0]
+    out: Dict[str, List[Any]] = {}
+    for key in ("bottom", "top"):
+        if key in entry:
+            out[key] = list(entry[key])
+    v0_type = str(inner.get("type", [""])[0])
+    if v0_type.startswith("\0STR"):
+        v0_type = v0_type[4:]
+    if "name" in inner:
+        out["name"] = list(inner["name"])
+    if v0_type:
+        if v0_type not in _V0_LAYER_TYPES:
+            raise ValueError(f"unknown V0 layer type {v0_type!r}")
+        out["type"] = [_V0_LAYER_TYPES[v0_type]]
+    for field, values in inner.items():
+        if field in ("name", "type"):
+            continue
+        if field in ("blobs_lr", "weight_decay", "blobs"):
+            out.setdefault(field, []).extend(values)
+            continue
+        route = _V0_ROUTES.get((field, v0_type))
+        if route is None:
+            raise ValueError(
+                f"V0 field {field!r} has no upgrade for layer type "
+                f"{v0_type!r} (upgrade_proto.cpp would mark this net "
+                "not fully compatible)"
+            )
+        sub, new_name = route
+        subdicts = out.setdefault(sub, [{}])
+        subdicts[0].setdefault(new_name, []).extend(values)
+    return out
+
+
+def _upgrade_v0_tokens(d: Dict[str, List[Any]]) -> None:
+    """Rewrite V0 connections inside a NetParameter token dict in place;
+    pure-V1 ``layers`` entries pass through untouched."""
+    entries = d.get("layers")
+    if not entries:
+        return
+    d["layers"] = [
+        _upgrade_v0_entry(e) if isinstance(e, dict) and "layer" in e else e
+        for e in entries
+    ]
+
+
 def parse(text: str, cls: Type[Message], permissive: bool = False) -> Message:
     """Parse prototxt text into an instance of ``cls``."""
     d = _parse_tokens(_tokenize(text))
+    if cls is schema.NetParameter:
+        _upgrade_v0_tokens(d)
     return _bind(cls, d, permissive)
 
 

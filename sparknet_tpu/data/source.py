@@ -81,21 +81,29 @@ def _record_shape(db_path, channels, h, w):
     with runtime.RecordDB(db_path, "r") as db:
         if len(db) == 0:
             raise IOError(f"empty db {db_path}")
-        nbytes = len(db.read(0)[1]) - 1
-    if nbytes == channels * h * w:
-        return channels, h, w
-    side = math.isqrt(nbytes // channels)
-    if channels * side * side != nbytes:
-        raise ValueError(
-            f"db {db_path} records carry {nbytes} image bytes; neither "
-            f"{channels}x{h}x{w} nor a square {channels}-channel image"
-        )
-    return channels, side, side
+        total = len(db.read(0)[1])
+    for label_w in (1, 2):  # records carry a 1- or 2-byte label
+        nbytes = total - label_w
+        if nbytes == channels * h * w:
+            return channels, h, w
+        side = math.isqrt(max(0, nbytes // channels))
+        if side and channels * side * side == nbytes:
+            return channels, side, side
+    raise ValueError(
+        f"db {db_path} records carry {total} bytes; neither "
+        f"{channels}x{h}x{w} nor a square {channels}-channel image "
+        "(with a 1- or 2-byte label)"
+    )
 
 
 def _db_batches(source, transform_param, net, iterations, phase, seed):
     from sparknet_tpu import runtime
-    from sparknet_tpu.io import caffemodel
+    from sparknet_tpu.io import caffemodel, lmdb
+
+    if lmdb.is_lmdb(source):
+        # reference-created dataset (backend: LMDB): one-time import into
+        # the native record format, then the normal pipeline applies
+        source = lmdb.lmdb_to_record_db(source)
 
     feed = net.feed_blobs
     shape = net.blob_shapes[feed[0]]
@@ -150,6 +158,24 @@ def resolve_batches(
     db_lp = _db_layer(netp, phase) if netp is not None else None
     if data:
         if os.path.isdir(data):
+            import glob
+
+            from sparknet_tpu.io import lmdb
+
+            if lmdb.is_lmdb(data):
+                tp = db_lp.transform_param if db_lp is not None else None
+                return _db_batches(data, tp, net, iterations, phase, seed)
+            has_cifar = glob.glob(
+                os.path.join(data, "data_batch_*.bin")
+            ) or os.path.exists(os.path.join(data, "test_batch.bin"))
+            if not has_cifar:
+                raise ValueError(
+                    f"--data={data!r} is a directory without CIFAR binary "
+                    "batches (data_batch_*.bin / test_batch.bin) and not an "
+                    "LMDB; supported forms: a CIFAR binary dir, a Caffe "
+                    "LMDB, a record-DB file path, or a net with "
+                    "data_param.source"
+                )
             return _cifar_batches(data, net, iterations, phase, seed)
         if os.path.exists(data):
             # explicit DB file: still honor the net's transform_param so

@@ -177,6 +177,78 @@ class JaxNet:
             b for b in self.feed_blobs if not (b in seen or seen.add(b))
         ]
 
+        self._plan_fusion()
+
+    # ------------------------------------------------------------------
+    # Layer fusion (TPU-first: the LRN+MaxPool sandwich never
+    # materializes the LRN output in HBM — see ops/pallas_plp.py)
+    # ------------------------------------------------------------------
+    def _plan_fusion(self) -> None:
+        import os
+
+        self._plp_fused: Dict[int, Tuple[str, object]] = {}
+        self._plp_skip: set = set()
+        # Opt-in (SPARKNET_FUSION=1): on the current virtualized v5e the
+        # Mosaic kernel's per-band overheads outweigh its HBM savings
+        # (measured 2-5x slower than the XLA lowering — see
+        # ops/pallas_plp.py and PERF.md); the kernel is kept correct and
+        # tested as the template for environments where the tradeoff
+        # flips.
+        if os.environ.get("SPARKNET_FUSION", "") != "1":
+            return
+        if self.phase != "TRAIN":
+            # keep the full named-blob map (getData parity) outside the
+            # training hot path
+            return
+        from sparknet_tpu.config.schema import LRNParameter
+        from sparknet_tpu.ops import pallas_plp
+        from sparknet_tpu.ops.vision import _pool_geometry
+
+        consumers: Dict[str, int] = {}
+        for layer in self.layers:
+            for b in layer.lp.bottom:
+                consumers[b] = consumers.get(b, 0) + 1
+        for i in range(len(self.layers) - 1):
+            lrn, pool = self.layers[i], self.layers[i + 1]
+            if lrn.lp.type != "LRN" or pool.lp.type != "Pooling":
+                continue
+            mid = lrn.lp.top[0]
+            if list(pool.lp.bottom) != [mid] or consumers.get(mid, 0) != 1:
+                continue
+            if any(self._loss_weights[lrn.name]) or any(
+                self._loss_weights[pool.name]
+            ):
+                continue
+            np_ = lrn.lp.lrn_param or LRNParameter()
+            shape = self.blob_shapes[lrn.lp.bottom[0]]
+            if len(shape) != 4:
+                continue
+            h, w = shape[2], shape[3]
+            pp = pool.lp.pooling_param
+            if pp.global_pooling:
+                continue
+            try:
+                kernel, stride, pad, _ = _pool_geometry(pp, h, w)
+            except ValueError:
+                continue
+            if not pallas_plp.fusable(
+                np_.norm_region, np_.local_size, pp.pool, kernel, stride,
+                pad, h, w,
+            ):
+                continue
+            n, alpha, beta, k = (
+                int(np_.local_size),
+                float(np_.alpha),
+                float(np_.beta),
+                float(np_.k),
+            )
+
+            def fn(x, n=n, alpha=alpha, beta=beta, k=k):
+                return pallas_plp.lrn_maxpool(x, n, alpha, beta, k)
+
+            self._plp_fused[i] = (pool.lp.top[0], fn)
+            self._plp_skip.add(i + 1)
+
     # ------------------------------------------------------------------
     # Introspection (the `num_layers`/`layer_names`/blob enumeration side
     # of the engine API, ccaffe.h:30-45)
@@ -242,9 +314,16 @@ class JaxNet:
         batch: Dict[str, jax.Array],
         rng: Optional[jax.Array] = None,
         train: Optional[bool] = None,
+        perturb: Optional[Dict[str, jax.Array]] = None,
     ) -> NetOutputs:
         """Run the net. Returns every named blob (the ``getData`` analog,
-        Net.scala:173-191), the weighted total loss, and updated stats."""
+        Net.scala:173-191), the weighted total loss, and updated stats.
+
+        ``perturb`` adds a zero-valued tensor to each named top as it is
+        produced — differentiating w.r.t. those taps yields every
+        activation gradient in one backward pass (the diff side of the
+        reference's data/diff twin blobs; used by ``Solver.debug_info_pass``,
+        net.cpp:648-735)."""
         train = (self.phase == "TRAIN") if train is None else train
         blobs: Dict[str, jax.Array] = {}
         for b in self.feed_blobs:
@@ -257,6 +336,15 @@ class JaxNet:
         cd = self.compute_dtype
         for li, layer in enumerate(self.layers):
             lp = layer.lp
+            if li in self._plp_skip:
+                continue
+            if li in self._plp_fused:
+                pool_top, fn = self._plp_fused[li]
+                x = blobs[lp.bottom[0]]
+                if cd is not None and jnp.issubdtype(x.dtype, jnp.floating):
+                    x = x.astype(cd)
+                blobs[pool_top] = fn(x)
+                continue
             if isinstance(layer, data_layers._HostFed):
                 # host blobs keep their dtype: index-valued blobs (labels)
                 # must never round through bf16; consumers cast as needed
@@ -291,6 +379,11 @@ class JaxNet:
                             new_stats[ref.owner][ref.index] = arr.astype(
                                 cur.dtype
                             )
+            if perturb is not None:
+                tops = [
+                    top + perturb[name] if name in perturb else top
+                    for name, top in zip(lp.top, tops)
+                ]
             for w, top, name in zip(
                 self._loss_weights[layer.name], tops, lp.top
             ):

@@ -52,10 +52,11 @@ def _window_sum(v, n: int):
     (n-1)//2 — n static shifted adds."""
     c = v.shape[0]
     pre = (n - 1) // 2
+    post = n - 1 - pre
     acc = v
-    for d in range(1, min(pre, c - 1) + 1):  # rows above
+    for d in range(1, min(post, c - 1) + 1):  # channels i+d (post side)
         acc = acc + jnp.pad(v[d:], ((0, d), (0, 0)))
-    for d in range(1, min(n - pre - 1, c - 1) + 1):  # rows below
+    for d in range(1, min(pre, c - 1) + 1):  # channels i-d (pre side)
         acc = acc + jnp.pad(v[:-d], ((d, 0), (0, 0)))
     return acc
 

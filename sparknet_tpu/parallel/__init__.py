@@ -26,8 +26,11 @@ from sparknet_tpu.parallel.trainers import (  # noqa: F401
     AllReduceTrainer,
     ParameterAveragingTrainer,
     first_worker,
+    local_worker_slice,
     replicate,
+    replicate_global,
     shard_leading,
+    shard_leading_global,
 )
 from sparknet_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,

@@ -35,6 +35,7 @@ except AttributeError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
 from sparknet_tpu.solver import Solver, TrainState
+from sparknet_tpu.utils.rngs import train_key
 
 tree_map = jax.tree_util.tree_map
 
@@ -56,6 +57,62 @@ def shard_leading(tree, mesh: Mesh, axis: str = "dp"):
     """Shard every leaf's leading dimension over ``axis`` (the per-worker
     stacking used by the averaging trainer and for per-worker batches)."""
     return jax.device_put(tree, NamedSharding(mesh, P(axis)))
+
+
+def local_worker_slice(mesh: Mesh, axis: str = "dp") -> slice:
+    """This process's contiguous block of the ``axis`` dimension (worker
+    indices whose mesh position lands on local devices).  The host-side
+    data-sharding rule of a multi-host run: each host loads/feeds only
+    its own workers — the Spark-partitions-per-executor analog."""
+    devs = np.moveaxis(mesh.devices, mesh.axis_names.index(axis), 0)
+    pos = [
+        i
+        for i in range(mesh.shape[axis])
+        if all(
+            d.process_index == jax.process_index()
+            for d in np.atleast_1d(devs[i]).flat
+        )
+    ]
+    if not pos:
+        raise ValueError("this process owns no workers on the mesh")
+    if pos != list(range(pos[0], pos[-1] + 1)):
+        raise ValueError(f"non-contiguous local worker block {pos}")
+    return slice(pos[0], pos[-1] + 1)
+
+
+def shard_leading_global(tree_local, mesh: Mesh, axis: str = "dp"):
+    """Multi-host ``shard_leading``: every process passes only its LOCAL
+    workers' leading block (see ``local_worker_slice``); the result is one
+    global array spanning all hosts.  Single-process it expects the full
+    leading dim and degrades to ``shard_leading``."""
+    if jax.process_count() == 1:
+        return shard_leading(tree_local, mesh, axis)
+    sharding = NamedSharding(mesh, P(axis))
+    n = mesh.shape[axis]
+
+    def mk(x):
+        x = np.asarray(x)
+        return jax.make_array_from_process_local_data(
+            sharding, x, (n,) + tuple(x.shape[1:])
+        )
+
+    return tree_map(mk, tree_local)
+
+
+def replicate_global(tree, mesh: Mesh):
+    """Fully-replicated placement that also works multi-host (every process
+    passes the same host value — the initial weight broadcast semantics)."""
+    sharding = NamedSharding(mesh, P())
+    if jax.process_count() == 1:
+        return jax.device_put(tree, sharding)
+
+    def mk(x):
+        x = np.asarray(x)
+        return jax.make_array_from_callback(
+            x.shape, sharding, lambda idx: x[idx]
+        )
+
+    return tree_map(mk, tree)
 
 
 class ParameterAveragingTrainer:
@@ -129,13 +186,28 @@ class ParameterAveragingTrainer:
         sharded over ``dp``."""
         st = self.solver.init_state(seed)
         n = self.num_workers
-        stacked = tree_map(lambda x: jnp.broadcast_to(x, (n,) + x.shape), st)
-        return shard_leading(stacked, self.mesh, self.axis)
+        if jax.process_count() == 1:
+            stacked = tree_map(
+                lambda x: jnp.broadcast_to(x, (n,) + x.shape), st
+            )
+            return shard_leading(stacked, self.mesh, self.axis)
+        # multi-host: identical init everywhere; each process materializes
+        # its local workers' shards from the broadcast value
+        sharding = NamedSharding(self.mesh, P(self.axis))
+
+        def mk(x):
+            x = np.asarray(x)
+            full = np.broadcast_to(x, (n,) + x.shape)
+            return jax.make_array_from_callback(
+                full.shape, sharding, lambda idx: full[idx]
+            )
+
+        return tree_map(mk, st)
 
     def round(self, state: TrainState, batches: Dict[str, jax.Array], rng=None):
         """One averaging round: ``batches[blob]`` is (num_workers, tau, ...)
         — worker-major, tau-deep.  Returns (state, losses (workers, tau))."""
-        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        rng = rng if rng is not None else train_key(0)
         state, losses = self._round(state, batches, rng)
         # smoothed-loss window from the ADDRESSABLE shards only — in a
         # multi-host run each process sees its own workers (the reference
@@ -155,9 +227,22 @@ class ParameterAveragingTrainer:
         own first ``counts[w]`` batches (the reference's per-partition
         full-pass sampler, CifarApp.scala:103-106)."""
         if counts is None:
-            nb = len(next(iter(batches.values()))[0])
+            nb = (
+                next(iter(batches.values())).shape[1]
+                if jax.process_count() > 1
+                else len(next(iter(batches.values()))[0])
+            )
             counts = np.full((self.num_workers,), nb, np.int32)
-        out = self._eval(state, batches, jnp.asarray(counts, jnp.int32))
+        counts = np.asarray(counts, np.int32)
+        if jax.process_count() > 1 and counts.shape[0] == self.num_workers:
+            # pass the GLOBAL counts on every host; place like the state
+            sharding = NamedSharding(self.mesh, P(self.axis))
+            counts_arr = jax.make_array_from_callback(
+                counts.shape, sharding, lambda idx: counts[idx]
+            )
+        else:
+            counts_arr = jnp.asarray(counts, jnp.int32)
+        out = self._eval(state, batches, counts_arr)
         return {k: float(v) for k, v in jax.device_get(out).items()}
 
     @staticmethod
@@ -253,7 +338,7 @@ class AllReduceTrainer:
     def step(self, state: TrainState, batches: Dict[str, jax.Array], rng=None):
         """tau synchronous steps on a globally-sharded batch
         (batches[blob]: (tau, global_B, ...))."""
-        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        rng = rng if rng is not None else train_key(0)
         batches = jax.device_put(batches, self._batch_sharding)
         state, losses = self._jit_round(state, batches, rng)
         for l in list(jax.device_get(losses)):

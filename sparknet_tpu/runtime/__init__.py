@@ -222,12 +222,19 @@ def write_datum_db(
     commit_every: int = 1000,
 ) -> None:
     """Write (N, C, H, W) uint8 images + labels as Datum-style records
-    (1 label byte + pixel bytes), committing every ``commit_every`` puts
-    like the reference's CreateDB."""
+    (label + pixel bytes), committing every ``commit_every`` puts like
+    the reference's CreateDB.  The label is 1 byte when every label fits
+    (CIFAR-scale) or 2 little-endian bytes otherwise (1000-class
+    ImageNet); readers infer the width from record length vs the known
+    image size."""
     images = np.ascontiguousarray(images, dtype=np.uint8)
+    labels = np.asarray(labels)
+    if len(labels) and not 0 <= int(labels.max()) <= 0xFFFF:
+        raise ValueError(f"labels exceed 2-byte range: max {labels.max()}")
+    width = 1 if (len(labels) == 0 or int(labels.max()) <= 0xFF) else 2
     with RecordDB(path, "w") as db:
         for i in range(len(labels)):
-            value = bytes([int(labels[i]) & 0xFF]) + images[i].tobytes()
+            value = int(labels[i]).to_bytes(width, "little") + images[i].tobytes()
             db.put(b"%08d" % i, value)
             if (i + 1) % commit_every == 0:
                 db.commit()
@@ -315,16 +322,18 @@ class DataPipeline:
                 for i in range(self.batch_size):
                     _, value = db.read(idx)
                     idx = (idx + 1) % n
-                    if len(value) != record_bytes:
+                    if len(value) not in (record_bytes, record_bytes + 1):
                         self._py_q.put(
                             IOError(
                                 f"record size mismatch: got {len(value)}, "
-                                f"want {record_bytes}"
+                                f"want {record_bytes} or {record_bytes + 1}"
                             )
                         )
                         return
-                    labels[i] = value[0]
-                    img = np.frombuffer(value, np.uint8, offset=1).reshape(
+                    # label width (1 or 2 bytes) inferred from length
+                    lw = len(value) - (record_bytes - 1)
+                    labels[i] = int.from_bytes(value[:lw], "little")
+                    img = np.frombuffer(value, np.uint8, offset=lw).reshape(
                         self.c, self.h, self.w
                     ).astype(np.float32)
                     if crop:

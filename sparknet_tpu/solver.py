@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from sparknet_tpu.config import load_net_prototxt
 from sparknet_tpu.config.schema import NetParameter, SolverParameter, solver_method
 from sparknet_tpu.net import JaxNet, Params, Stats
+from sparknet_tpu.utils.rngs import train_key
 
 
 class TrainState(NamedTuple):
@@ -332,7 +333,7 @@ class Solver:
         """Run ``tau`` iterations on the SAME device-resident batch inside
         one jitted program.  One dispatch for the whole window — use for
         throughput measurement (bench.py) or single-batch overfit tests."""
-        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        rng = rng if rng is not None else train_key(0)
         if not hasattr(self, "_jit_step_repeat"):
             self._jit_step_repeat = jax.jit(
                 self._step_repeat, donate_argnums=(0,), static_argnums=(3,)
@@ -348,11 +349,85 @@ class Solver:
         """Run ``tau`` iterations where tau is the leading axis of every
         entry in ``batches`` (the ``solver_step(state, tau)`` analog,
         ccaffe.cpp:230-233).  Returns (new_state, per-iter losses)."""
-        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        rng = rng if rng is not None else train_key(0)
+        if self.param.debug_info:
+            first = jax.tree_util.tree_map(lambda x: x[0], batches)
+            self.debug_info_pass(state, first, rng=rng)
         state, losses = self._jit_step(state, batches, rng)
         for l in list(jax.device_get(losses)):
             self._loss_window.append(float(l))
         return state, losses
+
+    # ------------------------------------------------------------------
+    # debug_info (reference: net.cpp:648-735, gated by
+    # SolverParameter.debug_info) — per-blob mean-|x| tracing
+    # ------------------------------------------------------------------
+    def debug_info_pass(self, state: TrainState, batch, rng=None, log=None):
+        """Log every blob's data / diff mean absolute value in the
+        reference's ``[Forward]`` / ``[Backward]`` / ``[Update]`` line
+        format.  One unjitted diagnostic pass (the reference pays this
+        per iteration; here ``step`` runs it once per tau-window when
+        ``debug_info`` is set — tracing inside the fused scan would
+        serialize it)."""
+        import sys
+
+        log = log or (lambda s: print(s, file=sys.stderr))
+        rng = rng if rng is not None else train_key(0)
+        net = self.net
+
+        def asum(x):
+            x = jax.device_get(x)
+            return float(jnp.mean(jnp.abs(jnp.asarray(x, jnp.float32))))
+
+        out = net.apply(state.params, state.stats, batch, rng=rng, train=True)
+        for b in net.feed_blobs:
+            log(f"    [Forward] Input {b} data: {asum(batch[b]):.6g}")
+        for layer in net.layers:
+            for top in layer.lp.top:
+                log(
+                    f"    [Forward] Layer {layer.name}, top blob {top} "
+                    f"data: {asum(out.blobs[top]):.6g}"
+                )
+            for pi, blob in enumerate(state.params.get(layer.name, [])):
+                log(
+                    f"    [Forward] Layer {layer.name}, param blob {pi} "
+                    f"data: {asum(blob):.6g}"
+                )
+
+        # every activation gradient in one backward pass via zero taps
+        taps = {
+            name: jnp.zeros(shape, jnp.float32)
+            for name, shape in net.blob_shapes.items()
+            if name not in net.feed_blobs
+        }
+
+        def loss_fn(params, eps):
+            return net.apply(
+                params, state.stats, batch, rng=rng, train=True, perturb=eps
+            ).loss
+
+        param_g, tap_g = jax.grad(loss_fn, argnums=(0, 1))(
+            state.params, taps
+        )
+        for layer in reversed(net.layers):
+            for bot in layer.lp.bottom:
+                if bot in tap_g:
+                    log(
+                        f"    [Backward] Layer {layer.name}, bottom blob "
+                        f"{bot} diff: {asum(tap_g[bot]):.6g}"
+                    )
+            for pi in range(len(param_g.get(layer.name, []))):
+                log(
+                    f"    [Backward] Layer {layer.name}, param blob {pi} "
+                    f"diff: {asum(param_g[layer.name][pi]):.6g}"
+                )
+        for layer in net.layers:
+            for pi, blob in enumerate(state.params.get(layer.name, [])):
+                log(
+                    f"    [Update] Layer {layer.name}, param {pi} "
+                    f"data: {asum(blob):.6g}; "
+                    f"diff: {asum(param_g[layer.name][pi]):.6g}"
+                )
 
     @property
     def smoothed_loss(self) -> float:

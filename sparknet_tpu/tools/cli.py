@@ -161,6 +161,102 @@ def cmd_device_query(args) -> int:
     return 0
 
 
+def cmd_convert_imageset(args) -> int:
+    """``convert_imageset [--shuffle] [--resize WxH] [--backend B] ROOT
+    LISTFILE DB`` — build a DB of Datum records from an image tree + a
+    "<relpath> <label>" listfile (reference:
+    ``caffe/tools/convert_imageset.cpp``).  ``--backend sndb`` (default)
+    writes the native record format; ``--backend lmdb`` writes a Caffe
+    LMDB through ``io/lmdb.py``."""
+    import os
+
+    from PIL import Image
+
+    entries = []
+    with open(args.listfile) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            name, label = line.rsplit(None, 1)
+            entries.append((name, int(label)))
+    if args.shuffle:  # FLAGS_shuffle
+        np.random.RandomState(args.seed).shuffle(entries)
+
+    images, labels = [], []
+    for name, label in entries:
+        img = Image.open(os.path.join(args.root, name))
+        img = img.convert("L" if args.gray else "RGB")
+        if args.resize_width and args.resize_height:
+            img = img.resize((args.resize_width, args.resize_height))
+        arr = np.asarray(img, np.uint8)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        images.append(np.ascontiguousarray(arr.transpose(2, 0, 1)))
+        labels.append(label)
+    if not images:
+        print("convert_imageset: empty listfile", file=sys.stderr)
+        return 1
+    shapes = {im.shape for im in images}
+    if args.check_size and len(shapes) > 1:
+        print(f"convert_imageset: sizes differ: {shapes}", file=sys.stderr)
+        return 1
+    if len(shapes) > 1:
+        raise SystemExit(
+            "images have differing sizes; pass --resize_width/--resize_height"
+        )
+    stacked = np.stack(images)
+    if args.backend == "lmdb":
+        from sparknet_tpu.io import lmdb
+
+        lmdb.write_datum_lmdb(args.db, stacked, labels)
+    else:
+        from sparknet_tpu import runtime
+
+        runtime.write_datum_db(args.db, stacked, np.asarray(labels))
+    print(f"Processed {len(labels)} files.")
+    return 0
+
+
+def cmd_compute_image_mean(args) -> int:
+    """``compute_image_mean DB [OUTPUT]`` — streaming mean image of a
+    Datum DB, written as mean.binaryproto (reference:
+    ``caffe/tools/compute_image_mean.cpp``)."""
+    from sparknet_tpu.io import caffemodel, lmdb
+
+    total = None
+    count = 0
+    if lmdb.is_lmdb(args.db):
+        it = (img for img, _ in lmdb.read_datum_lmdb(args.db))
+    else:
+        from sparknet_tpu import runtime
+        from sparknet_tpu.data.source import _record_shape
+
+        c, h, w = _record_shape(args.db, args.channels, 0, 0)
+
+        def _iter_sndb():
+            with runtime.RecordDB(args.db) as db:
+                for i in range(len(db)):
+                    _, value = db.read(i)
+                    lw = len(value) - c * h * w  # 1- or 2-byte label
+                    yield np.frombuffer(value[lw:], np.uint8).reshape(c, h, w)
+
+        it = _iter_sndb()
+    for img in it:
+        s = img.astype(np.int64)
+        total = s if total is None else total + s
+        count += 1
+    if total is None:
+        print("compute_image_mean: empty db", file=sys.stderr)
+        return 1
+    mean = (total.astype(np.float64) / count).astype(np.float32)
+    caffemodel.save_mean_image(mean, args.output)
+    print(f"Number of items: {count}")
+    for ch in range(mean.shape[0]):
+        print(f"mean_value channel [{ch}]: {mean[ch].mean():.6g}")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="sparknet_tpu", description=__doc__)
     sub = parser.add_subparsers(dest="command", required=True)
@@ -197,6 +293,27 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("device_query")
     p.set_defaults(fn=cmd_device_query)
+
+    p = sub.add_parser("convert_imageset")
+    p.add_argument("root", help="image tree root")
+    p.add_argument("listfile", help='"<relpath> <label>" lines')
+    p.add_argument("db", help="output DB path")
+    p.add_argument("--gray", action="store_true")
+    p.add_argument("--shuffle", action="store_true")
+    p.add_argument("--backend", choices=["sndb", "lmdb"], default="sndb")
+    p.add_argument("--resize_width", type=int, default=0)
+    p.add_argument("--resize_height", type=int, default=0)
+    p.add_argument("--check_size", action="store_true")
+    p.add_argument("--seed", type=int, default=0)
+    p.set_defaults(fn=cmd_convert_imageset)
+
+    p = sub.add_parser("compute_image_mean")
+    p.add_argument("db")
+    p.add_argument("output", nargs="?", default="mean.binaryproto")
+    p.add_argument("--channels", type=int, default=3,
+                   help="record channels for raw DBs (1 for --gray sets; "
+                   "LMDB Datums carry their own shape)")
+    p.set_defaults(fn=cmd_compute_image_mean)
 
     args = parser.parse_args(argv)
     return args.fn(args)

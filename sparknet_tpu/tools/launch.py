@@ -1,0 +1,185 @@
+"""Multi-host launcher — the cluster bring-up layer (L8).
+
+Reference: ``ec2/spark_ec2.py`` (provision EC2, wire master/workers, submit
+apps) + ``SETUP.md``.  On TPU there is nothing to *provision* from inside
+the job — the pod slice exists and every host runs the same program — so
+the L8 role reduces to: start one process per host, join them through
+``jax.distributed`` (``parallel/mesh.py initialize_distributed``), shard
+the data per host, and run the app.  This tool does all three:
+
+Local simulation (N processes on this machine, CPU devices standing in
+for per-host chips — the development / CI path)::
+
+    python -m sparknet_tpu.tools.launch --nprocs=2 --devices_per_host=2 \
+        cifar --rounds=3 --tau=2
+
+One process per real host (run the same line on EVERY host of the slice;
+on Cloud TPU use ``gcloud ... ssh --worker=all --command=...``)::
+
+    python -m sparknet_tpu.tools.launch \
+        --coordinator=10.0.0.2:8476 --num_processes=4 --process_id=$WORKER_ID \
+        imagenet --data=/mnt/imagenet --rounds=100
+
+On a Cloud TPU VM the three flags can all be omitted —
+``jax.distributed.initialize()`` discovers the slice topology from the
+metadata server — so ``launch imagenet ...`` alone is a full bring-up.
+
+Apps see the joined runtime: ``jax.process_count() > 1`` switches them to
+global-mesh mode, loading only their own workers' partitions (see
+``parallel.local_worker_slice``).  SETUP.md walks the full path from
+"N TPU VMs" to a running multi-host ImageNetApp.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+APPS = {
+    "cifar": "sparknet_tpu.apps.cifar_app",
+    "imagenet": "sparknet_tpu.apps.imagenet_app",
+    "cifar_db": "sparknet_tpu.apps.cifar_db_app",
+    "imagenet_create_db": "sparknet_tpu.apps.imagenet_create_db_app",
+    "imagenet_run_db": "sparknet_tpu.apps.imagenet_run_db_app",
+    "featurizer": "sparknet_tpu.apps.featurizer_app",
+}
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_app(app: str, app_argv, coordinator, num_processes, process_id) -> int:
+    """Join the distributed runtime, then hand off to the app's main()."""
+    import importlib
+
+    from sparknet_tpu.parallel.mesh import initialize_distributed
+
+    if coordinator or num_processes is not None:
+        initialize_distributed(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    else:
+        # Cloud TPU VM: topology comes from the metadata server
+        initialize_distributed()
+    mod = importlib.import_module(APPS[app])
+    return int(mod.main(list(app_argv)) or 0)
+
+
+def spawn_local(args, app_argv) -> int:
+    """The CI/dev path: N OS processes on this machine, each given
+    ``devices_per_host`` virtual CPU devices — process boundaries stand in
+    for host boundaries exactly as in tests/test_multihost.py."""
+    port = free_port()
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env_base = {
+        **os.environ,
+        "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        "PALLAS_AXON_POOL_IPS": "",  # never route the sim through a TPU tunnel
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": (
+            f"--xla_force_host_platform_device_count={args.devices_per_host} "
+            + os.environ.get("SPARKNET_EXTRA_XLA_FLAGS", "")
+        ).strip(),
+    }
+    import threading
+
+    procs = []
+    outputs = []
+    readers = []
+    for pid in range(args.nprocs):
+        cmd = [
+            sys.executable,
+            "-m",
+            "sparknet_tpu.tools.launch",
+            f"--coordinator=127.0.0.1:{port}",
+            f"--num_processes={args.nprocs}",
+            f"--process_id={pid}",
+            args.app,
+            *app_argv,
+        ]
+        p = subprocess.Popen(
+            cmd,
+            env=env_base,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        procs.append(p)
+        outputs.append([])
+        # drain every child's pipe CONCURRENTLY — a sequential
+        # communicate() deadlocks once any later child fills its 64KB
+        # pipe while an earlier one waits on it in a collective
+        t = threading.Thread(
+            target=lambda p=p, buf=outputs[-1]: buf.extend(p.stdout),
+            daemon=True,
+        )
+        t.start()
+        readers.append(t)
+
+    rc = 0
+    deadline = args.timeout
+    for pid, p in enumerate(procs):
+        try:
+            p.wait(timeout=deadline)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+            rc = 1
+    for t in readers:
+        t.join(timeout=30)
+    for pid, (p, buf) in enumerate(zip(procs, outputs)):
+        prefix = f"[host {pid}] "
+        sys.stdout.write(
+            "".join(prefix + line.rstrip("\n") + "\n" for line in buf)
+        )
+        if p.returncode != 0:
+            rc = rc or p.returncode or 1
+    return rc
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="launch", description=__doc__.split("\n", 1)[0]
+    )
+    parser.add_argument(
+        "--nprocs", type=int, default=0,
+        help="spawn N local processes (simulation mode); 0 = this process "
+        "IS one host of a real cluster",
+    )
+    parser.add_argument(
+        "--devices_per_host", type=int, default=2,
+        help="virtual CPU devices per simulated host (simulation mode)",
+    )
+    parser.add_argument(
+        "--coordinator", default=None, help="host:port of process 0"
+    )
+    parser.add_argument("--num_processes", type=int, default=None)
+    parser.add_argument("--process_id", type=int, default=None)
+    parser.add_argument("--timeout", type=int, default=1200)
+    parser.add_argument("app", choices=sorted(APPS))
+    parser.add_argument("app_argv", nargs=argparse.REMAINDER,
+                        help="arguments passed through to the app")
+    args = parser.parse_args(argv)
+    app_argv = [a for a in args.app_argv if a != "--"]
+
+    if args.nprocs:
+        return spawn_local(args, app_argv)
+    return run_app(
+        args.app, app_argv, args.coordinator, args.num_processes,
+        args.process_id,
+    )
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

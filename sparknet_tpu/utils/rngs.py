@@ -1,0 +1,27 @@
+"""Step-time PRNG policy.
+
+The reference engine draws dropout/transform randomness from a per-thread
+Mersenne generator (``caffe/src/caffe/common.cpp`` RNG) — cheap on CPU.
+JAX's default threefry2x32 is counter-based and reproducible but costs
+real VPU time per mask on TPU; the hardware RBG generator is the
+TPU-native equivalent of "a fast local generator" with the same
+functional-key API.  Training-step keys (dropout masks, crop/mirror
+draws, stochastic pooling) use RBG on TPU; *initialization* keys stay
+threefry everywhere so filler golden tests are backend-independent.
+
+``SPARKNET_PRNG=threefry2x32|rbg`` overrides.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def train_key(seed: int = 0) -> jax.Array:
+    """A typed PRNG key for training-step randomness (see module doc)."""
+    impl = os.environ.get("SPARKNET_PRNG")
+    if impl is None:
+        impl = "rbg" if jax.default_backend() == "tpu" else "threefry2x32"
+    return jax.random.key(seed, impl=impl)

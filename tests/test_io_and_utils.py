@@ -82,7 +82,8 @@ def test_mean_image_roundtrip(tmp_path):
     np.testing.assert_allclose(caffemodel.load_mean_image(path), mean)
 
 
-def test_snapshot_restore_continues_exactly(tmp_path):
+@pytest.mark.parametrize("fmt", ["BINARYPROTO", "HDF5"])
+def test_snapshot_restore_continues_exactly(tmp_path, fmt):
     prefix = str(tmp_path / "snap")
     batches = _batches(5)
     # straight-through run: 10 iters
@@ -96,8 +97,10 @@ def test_snapshot_restore_continues_exactly(tmp_path):
     s_a = _solver()
     st_a = s_a.init_state(0)
     st_a, _ = s_a.step(st_a, _batches(5, 0))
-    model_path, state_path = checkpoint.snapshot(s_a, st_a, prefix)
+    model_path, state_path = checkpoint.snapshot(s_a, st_a, prefix, fmt=fmt)
     assert os.path.exists(model_path) and os.path.exists(state_path)
+    if fmt == "HDF5":
+        assert model_path.endswith(".h5") and state_path.endswith(".h5")
 
     s_b = _solver()
     st_b = checkpoint.restore(s_b, state_path)

@@ -1,0 +1,120 @@
+"""Fused LRN+MaxPool kernel vs the unfused XLA path (interpret mode on
+CPU — the kernel's semantics contract; see ops/pallas_plp.py, PERF.md).
+
+Reference semantics: ``lrn_layer.cpp`` ACROSS_CHANNELS (alpha/n inside,
+centered pre-pad window) followed by ``pooling_layer.cpp`` MAX 3x3/2,
+first-max gradient routing.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from sparknet_tpu.ops import pallas_plp
+from sparknet_tpu.ops.vision import caffe_max_pool, lrn_across_channels
+
+PARAMS = (5, 1e-4, 0.75, 1.0)
+
+SHAPES = [
+    (2, 7, 11, 13),    # multi-band ragged, tiny C
+    (2, 96, 55, 55),   # AlexNet sandwich 1 geometry
+    (2, 256, 27, 27),  # AlexNet sandwich 2 geometry
+    (1, 32, 9, 9),     # single band
+    (2, 5, 3, 3),      # minimum pool input
+]
+
+
+def _ref(x, ph, pw):
+    n, alpha, beta, k = PARAMS
+    return caffe_max_pool(
+        lrn_across_channels(x, n, alpha, beta, k),
+        (3, 3), (2, 2), (0, 0), (ph, pw),
+    )
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+def test_forward_matches_unfused(shape):
+    n, alpha, beta, k = PARAMS
+    x = jnp.asarray(np.random.RandomState(0).randn(*shape), jnp.float32)
+    ph, pw = pallas_plp.pooled_hw(shape[2], shape[3])
+    got = pallas_plp.lrn_maxpool(x, n, alpha, beta, k)
+    assert got.shape == (shape[0], shape[1], ph, pw)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(_ref(x, ph, pw)), rtol=2e-5, atol=2e-6
+    )
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+def test_backward_matches_unfused(shape):
+    n, alpha, beta, k = PARAMS
+    x = jnp.asarray(np.random.RandomState(1).randn(*shape), jnp.float32)
+    ph, pw = pallas_plp.pooled_hw(shape[2], shape[3])
+
+    # sin() weighting gives every pooled position a distinct cotangent
+    g_ref = jax.grad(lambda v: jnp.sum(jnp.sin(_ref(v, ph, pw))))(x)
+    g_fused = jax.grad(
+        lambda v: jnp.sum(jnp.sin(pallas_plp.lrn_maxpool(v, n, alpha, beta, k)))
+    )(x)
+    np.testing.assert_allclose(
+        np.asarray(g_fused), np.asarray(g_ref), rtol=5e-5, atol=5e-6
+    )
+    assert not np.isnan(np.asarray(g_fused)).any()
+
+
+def test_net_level_fusion_matches_unfused(monkeypatch):
+    """JaxNet with SPARKNET_FUSION=1 fuses the AlexNet-style sandwich and
+    produces the same loss/gradients as the unfused net."""
+    from sparknet_tpu import config
+    from sparknet_tpu.net import JaxNet
+
+    NET = """
+    name: "plp"
+    layer { name: "data" type: "HostData" top: "data" top: "label"
+      java_data_param { shape { dim: 2 dim: 5 dim: 11 dim: 13 } shape { dim: 2 } } }
+    layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+      convolution_param { num_output: 4 kernel_size: 3
+        weight_filler { type: "xavier" } } }
+    layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+    layer { name: "norm1" type: "LRN" bottom: "conv1" top: "norm1"
+      lrn_param { local_size: 5 alpha: 0.0001 beta: 0.75 } }
+    layer { name: "pool1" type: "Pooling" bottom: "norm1" top: "pool1"
+      pooling_param { pool: MAX kernel_size: 3 stride: 2 } }
+    layer { name: "ip" type: "InnerProduct" bottom: "pool1" top: "logits"
+      inner_product_param { num_output: 3 weight_filler { type: "xavier" } } }
+    layer { name: "loss" type: "SoftmaxWithLoss" bottom: "logits"
+      bottom: "label" top: "loss" }
+    """
+    netp = config.parse_net_prototxt(NET)
+    rng = np.random.RandomState(2)
+    batch = {
+        "data": rng.randn(2, 5, 11, 13).astype(np.float32),
+        "label": rng.randint(0, 3, 2).astype(np.float32),
+    }
+
+    monkeypatch.setenv("SPARKNET_FUSION", "0")
+    plain = JaxNet(netp, phase="TRAIN")
+    assert not plain._plp_fused
+    params, stats = plain.init(seed=0)
+    loss_ref, grads_ref = jax.value_and_grad(
+        lambda p: plain.loss_fn(p, stats, batch, jax.random.PRNGKey(0))[0]
+    )(params)
+
+    monkeypatch.setenv("SPARKNET_FUSION", "1")
+    fused = JaxNet(netp, phase="TRAIN")
+    assert list(fused._plp_fused), "sandwich was not fused"
+    loss_f, grads_f = jax.value_and_grad(
+        lambda p: fused.loss_fn(p, stats, batch, jax.random.PRNGKey(0))[0]
+    )(params)
+
+    np.testing.assert_allclose(float(loss_f), float(loss_ref), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        ),
+        grads_f,
+        grads_ref,
+    )
+    # TEST phase keeps the full blob map (no fusion)
+    test_net = JaxNet(netp, phase="TEST")
+    assert not test_net._plp_fused

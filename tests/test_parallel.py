@@ -320,3 +320,26 @@ def test_heterogeneous_train_partitions_window_sampling():
     st, losses = trainer.round(st, shard_leading(stacked, mesh))
     assert losses.shape == (4, tau)
     assert np.isfinite(np.asarray(losses)).all()
+
+
+def test_scaling_sweep_round_invariants():
+    """CI guard for the BENCH_MODE=scaling sweep (SCALING_r03.json): at
+    every dp in 1..8 a round must compile, produce finite losses, and
+    leave all workers' params bitwise identical post-pmean — the
+    structural invariants a collective-shape regression would break
+    (reference scaling protocol: caffe/docs/multigpu.md:23-27)."""
+    for dp in (1, 2, 4, 8):
+        solver = _solver()
+        mesh = make_mesh({"dp": dp}, devices=jax.devices()[:dp])
+        trainer = ParameterAveragingTrainer(solver, mesh)
+        state = trainer.init_state(seed=0)
+        state, losses = trainer.round(
+            state, shard_leading(_data(dp, tau=2, seed=dp), mesh)
+        )
+        losses = np.asarray(losses)
+        assert losses.shape == (dp, 2) and np.isfinite(losses).all(), dp
+        for key, blobs in state.params.items():
+            for blob in blobs:
+                arr = np.asarray(blob)
+                for w in range(1, dp):
+                    np.testing.assert_array_equal(arr[0], arr[w])

@@ -40,6 +40,42 @@ def test_db_roundtrip_native(native_built, tmp_path):
         np.testing.assert_array_equal(got, images[3])
 
 
+def test_wide_labels_roundtrip_native_and_python(native_built, tmp_path):
+    """1000-class labels (2-byte records): both the native pipeline and
+    the Python fallback read them back exactly — the real-ImageNet case
+    the 1-byte convention silently wrapped."""
+    path = tmp_path / "wide.sndb"
+    rng = np.random.RandomState(1)
+    images = rng.randint(0, 256, (8, 3, 6, 6)).astype(np.uint8)
+    labels = np.asarray([0, 255, 256, 999, 500, 1, 731, 42])
+    runtime.write_datum_db(str(path), images, labels)
+    with runtime.RecordDB(str(path), "r") as db:
+        assert len(db.read(0)[1]) == 2 + 3 * 6 * 6
+
+    p = runtime.DataPipeline(str(path), batch_size=8, shape=(3, 6, 6))
+    data, labs = p.next()
+    p.close()
+    np.testing.assert_array_equal(labs, labels.astype(np.float32))
+    np.testing.assert_array_equal(data, images.astype(np.float32))
+
+    # python fallback path agrees
+    p2 = runtime.DataPipeline.__new__(runtime.DataPipeline)
+    p2.batch_size, p2.c, p2.h, p2.w = 8, 3, 6, 6
+    p2.out_h = p2.out_w = 6
+    p2._lib = None
+    p2._handle = None
+    p2._py_init(str(path), 0, False, True, 1.0, None, 0, 3)
+    data2, labs2 = p2.next()
+    p2.close()
+    np.testing.assert_array_equal(labs2, labels.astype(np.float32))
+    np.testing.assert_array_equal(data2, data)
+
+    with pytest.raises(ValueError, match="2-byte range"):
+        runtime.write_datum_db(
+            str(tmp_path / "bad.sndb"), images[:1], np.asarray([70000])
+        )
+
+
 def test_db_python_fallback_reads_native_file(native_built, tmp_path):
     path = tmp_path / "compat.sndb"
     images, labels = _write_db(path)
