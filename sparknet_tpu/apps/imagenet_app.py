@@ -253,9 +253,13 @@ def main(argv=None) -> int:
     del train_parts, test_parts  # samplers/test_batches hold the only copy
 
     # net: cropped feed shapes (replaceDataLayers, ImageNetApp.scala:103-104)
-    netp = models.load_model(args.model) if args.model in (
-        "cifar10_full", "lenet", "alexnet"
-    ) else models.load_model(args.model, classes=args.classes)
+    from sparknet_tpu.models.builders import BUILDERS
+
+    netp = (
+        models.load_model(args.model, classes=args.classes)
+        if args.model in BUILDERS  # prototxt-backed models take no kwargs
+        else models.load_model(args.model)
+    )
     netp = cfg.replace_data_layers(
         netp,
         [(args.train_batch, 3, args.crop, args.crop), (args.train_batch,)],

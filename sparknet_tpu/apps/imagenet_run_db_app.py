@@ -120,9 +120,13 @@ def main(argv=None) -> int:
         for w in range(n_workers)
     ]
 
-    netp = models.load_model(args.model) if args.model in (
-        "alexnet",
-    ) else models.load_model(args.model, classes=int(info["classes"]))
+    from sparknet_tpu.models.builders import BUILDERS
+
+    netp = (
+        models.load_model(args.model, classes=int(info["classes"]))
+        if args.model in BUILDERS  # prototxt-backed models take no kwargs
+        else models.load_model(args.model)
+    )
     netp = cfg.replace_data_layers(
         netp,
         [(int(info["train_batch"]), 3, crop, crop), (int(info["train_batch"]),)],

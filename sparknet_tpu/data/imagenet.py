@@ -59,11 +59,26 @@ class ImageNetLoader:
 
     def __init__(self, root: str):
         self.root = root
+        # ``root`` may be a bucket/HTTP url — shards then stream over the
+        # network with no staging (ImageNetLoader.scala:25-54 semantics)
+        from sparknet_tpu.data import object_store
+
+        self._store = (
+            object_store.open_store(root)
+            if object_store.is_object_store_url(root)
+            else None
+        )
 
     # -- shard listing (getFilePathsRDD analog) -------------------------
     def list_shards(self, prefix: str = "") -> List[str]:
         """All tar shards (or loose images) whose path relative to root
         starts with ``prefix``, sorted for determinism."""
+        if self._store is not None:
+            return [
+                n
+                for n in self._store.list(prefix)
+                if n.endswith(".tar") or n.lower().endswith(self.IMAGE_EXTS)
+            ]
         out: List[str] = []
         for dirpath, _, files in os.walk(self.root):
             for fname in files:
@@ -81,15 +96,18 @@ class ImageNetLoader:
     def load_labels(self, labels_path: str) -> Dict[str, int]:
         """Parse ``train.txt``-format lines ("<path> <label>") into a
         basename->label map (ImageNetLoader.scala:41-54)."""
-        path = os.path.join(self.root, labels_path)
+        if self._store is not None:
+            lines = self._store.read(labels_path).decode().splitlines()
+        else:
+            with open(os.path.join(self.root, labels_path), "r") as f:
+                lines = f.read().splitlines()
         labels: Dict[str, int] = {}
-        with open(path, "r") as f:
-            for line in f:
-                parts = line.split()  # any whitespace (tabs, runs of spaces)
-                if not parts:
-                    continue
-                fpath, label = parts[0], parts[-1]
-                labels[os.path.basename(fpath)] = int(label)
+        for line in lines:
+            parts = line.split()  # any whitespace (tabs, runs of spaces)
+            if not parts:
+                continue
+            fpath, label = parts[0], parts[-1]
+            labels[os.path.basename(fpath)] = int(label)
         return labels
 
     # -- tar streaming (loadImagesFromTar analog) -----------------------
@@ -102,7 +120,15 @@ class ImageNetLoader:
         a partial label file usable, and corrupt-entry dropping is already
         the ScaleAndConvert contract)."""
         if shard_path.endswith(".tar"):
-            with tarfile.open(shard_path, "r") as tar:
+            if self._store is not None:
+                # sequential streaming decode off the network socket —
+                # the TarArchiveInputStream(getObjectContent) analog
+                stream = self._store.open(shard_path)
+                tar = tarfile.open(fileobj=stream, mode="r|*")
+            else:
+                stream = None
+                tar = tarfile.open(shard_path, "r")
+            with tar:
                 for entry in tar:
                     if not entry.isfile():
                         continue
@@ -113,11 +139,16 @@ class ImageNetLoader:
                     if f is None:
                         continue
                     yield f.read(), labels[name]
+            if stream is not None:
+                stream.close()
         else:
             name = os.path.basename(shard_path)
             if name in labels:
-                with open(shard_path, "rb") as f:
-                    yield f.read(), labels[name]
+                if self._store is not None:
+                    yield self._store.read(shard_path), labels[name]
+                else:
+                    with open(shard_path, "rb") as f:
+                        yield f.read(), labels[name]
 
     # -- partitioned load (the RDD role) --------------------------------
     def partitions(

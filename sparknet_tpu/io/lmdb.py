@@ -68,12 +68,20 @@ class LMDBReader:
     (``data.mdb`` inside — the reference's ``source:`` convention)."""
 
     def __init__(self, path: str):
+        import mmap
         import os
 
         if os.path.isdir(path):
             path = os.path.join(path, "data.mdb")
-        with open(path, "rb") as f:
-            self._buf = f.read()
+        # mmap, not read(): real reference datasets are hundreds of GB
+        # and the B-tree walk touches pages on demand
+        self._file = open(path, "rb")
+        try:
+            self._buf = mmap.mmap(
+                self._file.fileno(), 0, access=mmap.ACCESS_READ
+            )
+        except ValueError:
+            self._buf = b""  # zero-length file
         if len(self._buf) < 2 * PAGEHDRSZ + _META.size:
             raise LMDBError(f"{path}: too small for an LMDB file")
         metas = []
@@ -410,7 +418,10 @@ def lmdb_to_record_db(source: str, out: Optional[str] = None) -> str:
         src_file
     ):
         return out
-    with runtime.RecordDB(out, "w") as db:
+    # build at a temp path and publish atomically — an interrupted
+    # import must not leave a truncated file the cache check accepts
+    tmp = out + ".tmp"
+    with runtime.RecordDB(tmp, "w") as db:
         for i, (image, label) in enumerate(read_datum_lmdb(source)):
             # 2-byte labels: single streaming pass, and Caffe LMDBs are
             # routinely 1000-class (readers infer the width from record
@@ -424,6 +435,7 @@ def lmdb_to_record_db(source: str, out: Optional[str] = None) -> str:
             if (i + 1) % 1000 == 0:
                 db.commit()
         db.commit()
+    os.replace(tmp, out)
     return out
 
 

@@ -343,7 +343,10 @@ class JaxNet:
                 x = blobs[lp.bottom[0]]
                 if cd is not None and jnp.issubdtype(x.dtype, jnp.floating):
                     x = x.astype(cd)
-                blobs[pool_top] = fn(x)
+                y = fn(x)
+                if perturb is not None and pool_top in perturb:
+                    y = y + perturb[pool_top]
+                blobs[pool_top] = y
                 continue
             if isinstance(layer, data_layers._HostFed):
                 # host blobs keep their dtype: index-valued blobs (labels)

@@ -229,8 +229,13 @@ def write_datum_db(
     image size."""
     images = np.ascontiguousarray(images, dtype=np.uint8)
     labels = np.asarray(labels)
-    if len(labels) and not 0 <= int(labels.max()) <= 0xFFFF:
-        raise ValueError(f"labels exceed 2-byte range: max {labels.max()}")
+    if len(labels) and not (
+        0 <= int(labels.min()) and int(labels.max()) <= 0xFFFF
+    ):
+        raise ValueError(
+            f"labels outside [0, 65535]: min {labels.min()}, "
+            f"max {labels.max()}"
+        )
     width = 1 if (len(labels) == 0 or int(labels.max()) <= 0xFF) else 2
     with RecordDB(path, "w") as db:
         for i in range(len(labels)):

@@ -384,6 +384,8 @@ class Solver:
             log(f"    [Forward] Input {b} data: {asum(batch[b]):.6g}")
         for layer in net.layers:
             for top in layer.lp.top:
+                if top not in out.blobs:
+                    continue  # fused-away intermediate (SPARKNET_FUSION)
                 log(
                     f"    [Forward] Layer {layer.name}, top blob {top} "
                     f"data: {asum(out.blobs[top]):.6g}"
@@ -398,7 +400,7 @@ class Solver:
         taps = {
             name: jnp.zeros(shape, jnp.float32)
             for name, shape in net.blob_shapes.items()
-            if name not in net.feed_blobs
+            if name not in net.feed_blobs and name in out.blobs
         }
 
         def loss_fn(params, eps):

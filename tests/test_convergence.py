@@ -1,0 +1,53 @@
+"""Long-horizon convergence evidence (the strongest this offline env
+allows): the cifar10_full recipe on separable synthetic CIFAR must go
+from chance to a decisive accuracy with monotone-trending smoothed loss.
+
+The committed ``training_log_1785395928888_cifar.txt`` is the full-length
+artifact (3,000 iterations on the real chip: chance 8.9% -> 100% test
+accuracy by round 50, smoothed loss 2.3 -> 0.0012); this slow-marked test
+replays a shortened schedule in CI.  Reference schedule being exercised:
+``caffe/examples/cifar10/cifar10_full_solver.prototxt`` via CifarApp's
+loop (``CifarApp.scala:101-116``)."""
+
+import re
+
+import pytest
+
+from sparknet_tpu.apps import cifar_app
+
+
+@pytest.mark.slow
+def test_cifar_full_converges_decisively(tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)
+    rc = cifar_app.main([
+        "--rounds", "40",
+        "--tau", "5",
+        "--batch", "50",
+        "--test_every", "20",
+        "--workers", "2",
+        "--seed", "1",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+
+    accs = [float(m) for m in re.findall(r"accuracy (\d\.\d+)", out)]
+    assert accs, out
+    # starts near chance (10 classes), ends decisively above it (the
+    # full-length curve to 100% is the committed TPU log; this CI replay
+    # sees ~10k images on the 1-core host)
+    assert accs[0] < 0.35, accs
+    assert accs[-1] >= 0.50, accs
+
+    losses = [
+        float(m) for m in re.findall(r"smoothed_loss ([\d.]+)", out)
+    ]
+    assert len(losses) == 40
+    # monotone trend: each third of training improves on the previous
+    third = len(losses) // 3
+    a, b, c = (
+        sum(losses[:third]) / third,
+        sum(losses[third : 2 * third]) / third,
+        sum(losses[2 * third :]) / (len(losses) - 2 * third),
+    )
+    assert a > b > c, (a, b, c)
+    assert c < 1.5, c

@@ -1,0 +1,126 @@
+"""Object-store streaming ingestion (reference:
+``ImageNetLoader.scala:25-54`` lists S3 objects and streams tar shards
+off the network).  The fixture is a local ``http.server`` over a
+synthetic shard directory: HTTPStore's auto-index listing path doubles
+as the test transport, and GCSStore's listing/download endpoints are
+exercised against a tiny in-process emulator."""
+
+import http.server
+import json
+import os
+import threading
+import urllib.parse
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.data import ImageNetLoader, ScaleAndConvert
+from sparknet_tpu.data import object_store
+from sparknet_tpu.data.imagenet import write_synthetic_imagenet
+
+
+@pytest.fixture(scope="module")
+def shard_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("objstore"))
+    write_synthetic_imagenet(
+        d, num_shards=2, images_per_shard=6, classes=3, seed=0
+    )
+    return d
+
+
+@pytest.fixture()
+def http_root(shard_dir):
+    handler = lambda *a, **kw: http.server.SimpleHTTPRequestHandler(  # noqa: E731
+        *a, directory=shard_dir, **kw
+    )
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield f"http://127.0.0.1:{srv.server_address[1]}"
+    finally:
+        srv.shutdown()
+
+
+def test_http_store_lists_and_streams(http_root):
+    loader = ImageNetLoader(http_root)
+    shards = loader.list_shards("train.")
+    assert len(shards) == 2 and all(s.endswith(".tar") for s in shards)
+    labels = loader.load_labels("train.txt")
+    assert len(labels) == 12
+
+    items = list(loader.iter_shard(shards[0], labels))
+    assert len(items) == 6
+    jpeg, label = items[0]
+    assert jpeg[:2] == b"\xff\xd8" and 0 <= label < 3  # JPEG magic
+
+    # the full pipeline decodes streamed shards into minibatches
+    conv = ScaleAndConvert(batch_size=3, height=32, width=32)
+    parts = loader.partitions("train.", "train.txt", num_parts=2)
+    mbs = list(conv.make_minibatches(parts[0]))
+    assert mbs and mbs[0][0].shape == (3, 3, 32, 32)
+    assert mbs[0][0].dtype == np.uint8
+
+
+def test_http_store_index_txt_overrides_autoindex(shard_dir, http_root):
+    with open(os.path.join(shard_dir, "index.txt"), "w") as f:
+        f.write("train.0000.tar\n")
+    try:
+        store = object_store.open_store(http_root)
+        assert store.list("train.") == ["train.0000.tar"]
+    finally:
+        os.remove(os.path.join(shard_dir, "index.txt"))
+
+
+def test_gcs_store_against_emulator(shard_dir):
+    """GCSStore's JSON-list + alt=media fetch, against a minimal local
+    emulation of the two endpoints."""
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            parsed = urllib.parse.urlparse(self.path)
+            if parsed.path == "/storage/v1/b/mybucket/o":
+                q = urllib.parse.parse_qs(parsed.query)
+                prefix = q.get("prefix", [""])[0]
+                names = sorted(
+                    f
+                    for f in os.listdir(shard_dir)
+                    if ("imagenet/" + f).startswith(prefix)
+                )
+                body = json.dumps(
+                    {"items": [{"name": "imagenet/" + n} for n in names]}
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif parsed.path.startswith("/storage/v1/b/mybucket/o/"):
+                key = urllib.parse.unquote(
+                    parsed.path.rsplit("/", 1)[-1]
+                )  # imagenet/<name>
+                fn = os.path.join(shard_dir, key.split("/", 1)[1])
+                with open(fn, "rb") as f:
+                    body = f.read()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self.send_error(404)
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        store = object_store.GCSStore(
+            "gs://mybucket/imagenet",
+            endpoint=f"http://127.0.0.1:{srv.server_address[1]}",
+        )
+        shards = [n for n in store.list("train.") if n.endswith(".tar")]
+        assert len(shards) == 2
+        data = store.read("train.txt")
+        assert len(data.splitlines()) == 12
+    finally:
+        srv.shutdown()

@@ -70,9 +70,13 @@ def test_wide_labels_roundtrip_native_and_python(native_built, tmp_path):
     np.testing.assert_array_equal(labs2, labels.astype(np.float32))
     np.testing.assert_array_equal(data2, data)
 
-    with pytest.raises(ValueError, match="2-byte range"):
+    with pytest.raises(ValueError, match="outside"):
         runtime.write_datum_db(
             str(tmp_path / "bad.sndb"), images[:1], np.asarray([70000])
+        )
+    with pytest.raises(ValueError, match="outside"):
+        runtime.write_datum_db(
+            str(tmp_path / "bad2.sndb"), images[:1], np.asarray([-1])
         )
 
 

@@ -1,0 +1,53 @@
+"""Space-to-depth conv lowering (opt-in, SPARKNET_S2D=1): exact
+re-bracketing of the strided thin-stem convolution — see
+ops/vision.py:_s2d_conv and PERF.md (measured neutral on v5e)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from sparknet_tpu.ops.vision import _s2d_conv, _s2d_eligible
+
+
+@pytest.mark.parametrize(
+    "B,C,H,W,O,K,S",
+    [(2, 3, 227, 227, 8, 11, 4), (2, 3, 21, 21, 4, 5, 2),
+     (1, 4, 19, 23, 6, 7, 4)],
+)
+def test_s2d_matches_direct_conv(B, C, H, W, O, K, S):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(B, C, H, W), jnp.float32)
+    w = jnp.asarray(rng.randn(O, C, K, K) * 0.1, jnp.float32)
+    ref = lax.conv_general_dilated(
+        x, w, (S, S), [(0, 0), (0, 0)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    got = _s2d_conv(x, w, K, K, S, S)
+    assert got.shape == ref.shape
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=1e-4, atol=3e-4
+    )
+    gw_ref = jax.grad(
+        lambda w: jnp.sum(jnp.sin(lax.conv_general_dilated(
+            x, w, (S, S), [(0, 0), (0, 0)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))))
+    )(w)
+    gw = jax.grad(lambda w: jnp.sum(jnp.sin(_s2d_conv(x, w, K, K, S, S))))(w)
+    np.testing.assert_allclose(
+        np.asarray(gw), np.asarray(gw_ref), rtol=1e-4, atol=3e-4
+    )
+
+
+def test_s2d_gate(monkeypatch):
+    shape = (2, 3, 227, 227)
+    args = (shape, 11, 11, 4, 4, 0, 0, 1, 1, 1)
+    monkeypatch.delenv("SPARKNET_S2D", raising=False)
+    assert not _s2d_eligible(*args)  # opt-in only
+    monkeypatch.setenv("SPARKNET_S2D", "1")
+    assert _s2d_eligible(*args)
+    # padded / grouped / thick-input stems stay on the direct path
+    assert not _s2d_eligible(shape, 11, 11, 4, 4, 2, 2, 1, 1, 1)
+    assert not _s2d_eligible(shape, 11, 11, 4, 4, 0, 0, 1, 1, 2)
+    assert not _s2d_eligible((2, 96, 27, 27), 5, 5, 2, 2, 0, 0, 1, 1, 1)
