@@ -236,6 +236,11 @@ class DummyDataParameter(Message):
 
 
 @dataclass
+class ELUParameter(Message):
+    alpha: float = 1.0
+
+
+@dataclass
 class EltwiseParameter(Message):
     operation: str = "SUM"  # PROD | SUM | MAX
     coeff: List[float] = field(default_factory=list)
@@ -526,6 +531,7 @@ class LayerParameter(Message):
     dropout_param: Optional[DropoutParameter] = None
     dummy_data_param: Optional[DummyDataParameter] = None
     eltwise_param: Optional[EltwiseParameter] = None
+    elu_param: Optional[ELUParameter] = None
     embed_param: Optional[EmbedParameter] = None
     exp_param: Optional[ExpParameter] = None
     flatten_param: Optional[FlattenParameter] = None

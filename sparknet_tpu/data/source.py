@@ -104,6 +104,12 @@ def _db_batches(source, transform_param, net, iterations, phase, seed):
         # reference-created dataset (backend: LMDB): one-time import into
         # the native record format, then the normal pipeline applies
         source = lmdb.lmdb_to_record_db(source)
+    else:
+        from sparknet_tpu.io import leveldb
+
+        if leveldb.is_leveldb(source):
+            # backend: LEVELDB (Caffe's default) — same one-time import
+            source = leveldb.leveldb_to_record_db(source)
 
     feed = net.feed_blobs
     shape = net.blob_shapes[feed[0]]
@@ -162,7 +168,9 @@ def resolve_batches(
 
             from sparknet_tpu.io import lmdb
 
-            if lmdb.is_lmdb(data):
+            from sparknet_tpu.io import leveldb
+
+            if lmdb.is_lmdb(data) or leveldb.is_leveldb(data):
                 tp = db_lp.transform_param if db_lp is not None else None
                 return _db_batches(data, tp, net, iterations, phase, seed)
             has_cifar = glob.glob(
@@ -172,9 +180,9 @@ def resolve_batches(
                 raise ValueError(
                     f"--data={data!r} is a directory without CIFAR binary "
                     "batches (data_batch_*.bin / test_batch.bin) and not an "
-                    "LMDB; supported forms: a CIFAR binary dir, a Caffe "
-                    "LMDB, a record-DB file path, or a net with "
-                    "data_param.source"
+                    "LMDB or LevelDB; supported forms: a CIFAR binary dir, "
+                    "a Caffe LMDB or LevelDB, a record-DB file path, or a "
+                    "net with data_param.source"
                 )
             return _cifar_batches(data, net, iterations, phase, seed)
         if os.path.exists(data):

@@ -27,6 +27,7 @@ _NET_FILES = {
     "cifar10_full": "cifar10_full_train_test.prototxt",
     "lenet": "lenet_train_test.prototxt",
     "alexnet": "alexnet_train_val.prototxt",
+    "mnist_siamese": "mnist_siamese_train_test.prototxt",
 }
 
 _SOLVER_FILES = {
@@ -36,6 +37,7 @@ _SOLVER_FILES = {
     "caffenet": "caffenet_solver.prototxt",
     "googlenet": "googlenet_solver.prototxt",
     "resnet50": "resnet50_solver.prototxt",
+    "mnist_siamese": "mnist_siamese_solver.prototxt",
 }
 
 

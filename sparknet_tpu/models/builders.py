@@ -20,9 +20,12 @@ def _gauss(std):
     return {"type": "gaussian", "std": std}
 
 
-def caffenet(batch: int = 256, image: int = 227, classes: int = 1000) -> NetParameter:
-    """CaffeNet (reference: ``caffe/models/bvlc_reference_caffenet``):
-    AlexNet with pool-before-norm and no grouping changes."""
+def _caffenet_trunk(batch: int, image: int) -> List[LayerParameter]:
+    """data..fc7 of CaffeNet (reference:
+    ``caffe/models/bvlc_reference_caffenet``) — shared verbatim by the
+    R-CNN feature model and the Flickr-style fine-tune variant, whose
+    only deltas are the final head (``bvlc_reference_rcnn_ilsvrc13/
+    deploy.prototxt``, ``finetune_flickr_style/train_val.prototxt``)."""
     L: List[LayerParameter] = [
         dsl.host_data_layer(
             "data", ["data", "label"], [(batch, 3, image, image), (batch,)]
@@ -68,10 +71,57 @@ def caffenet(batch: int = 256, image: int = 227, classes: int = 1000) -> NetPara
     )
     L.append(dsl.relu_layer("relu7", "fc7"))
     L.append(dsl.dropout_layer("drop7", "fc7", 0.5))
+    return L
+
+
+def caffenet(batch: int = 256, image: int = 227, classes: int = 1000) -> NetParameter:
+    """CaffeNet (reference: ``caffe/models/bvlc_reference_caffenet``):
+    AlexNet with pool-before-norm and no grouping changes."""
+    L = _caffenet_trunk(batch, image)
     L.append(dsl.ip_layer("fc8", "fc7", classes, weight_filler=_gauss(0.01)))
     L.append(dsl.softmax_loss_layer("loss", "fc8"))
     L.append(dsl.accuracy_layer("accuracy", "fc8", phase="TEST"))
     return dsl.net_param("CaffeNet", *L)
+
+
+def flickr_style(batch: int = 50, image: int = 227, classes: int = 20) -> NetParameter:
+    """Flickr-style fine-tuning variant (reference:
+    ``caffe/models/finetune_flickr_style/train_val.prototxt``): CaffeNet
+    trunk under the *same layer names* — so a CaffeNet ``.caffemodel``
+    warm-starts everything below the head — with a fresh 20-way
+    ``fc8_flickr`` at 10x/20x lr_mult so only the new head learns fast."""
+    L = _caffenet_trunk(batch, image)
+    L.append(
+        dsl.ip_layer(
+            "fc8_flickr",
+            "fc7",
+            classes,
+            weight_filler=_gauss(0.01),
+            lr_mults=(10.0, 20.0),
+        )
+    )
+    L.append(dsl.softmax_loss_layer("loss", "fc8_flickr"))
+    L.append(dsl.accuracy_layer("accuracy", "fc8_flickr", phase="TEST"))
+    return dsl.net_param("FlickrStyleCaffeNet", *L)
+
+
+def rcnn_ilsvrc13(batch: int = 10, image: int = 227, classes: int = 200) -> NetParameter:
+    """R-CNN ILSVRC-2013 detection feature model (reference:
+    ``caffe/models/bvlc_reference_rcnn_ilsvrc13/deploy.prototxt``):
+    CaffeNet trunk with a 200-way ``fc-rcnn`` scoring head and *no*
+    loss — a deploy/featurization model (drive it through
+    FeaturizerApp / ``JaxNet.forward`` taps)."""
+    L = _caffenet_trunk(batch, image)
+    L.append(
+        dsl.ip_layer("fc-rcnn", "fc7", classes, weight_filler=_gauss(0.01))
+    )
+    net = dsl.net_param("R-CNN-ilsvrc13", *L)
+    # deploy models carry no label top: drop it from the data layer
+    net.layer[0].top = ["data"]
+    net.layer[0].java_data_param.shape = (
+        net.layer[0].java_data_param.shape[:1]
+    )
+    return net
 
 
 # ---------------------------------------------------------------------------
@@ -270,4 +320,6 @@ BUILDERS = {
     "caffenet": caffenet,
     "googlenet": googlenet,
     "resnet50": resnet50,
+    "flickr_style": flickr_style,
+    "rcnn_ilsvrc13": rcnn_ilsvrc13,
 }

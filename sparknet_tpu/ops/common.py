@@ -273,8 +273,9 @@ class PReLU(Layer):
 
 @register
 class ELU(Layer):
-    """Exponential linear unit — present in later reference revisions; kept
-    for zoo completeness."""
+    """Exponential linear unit — present in later reference revisions
+    (``elu_layer.cpp``: x > 0 ? x : alpha * (exp(x) - 1)); kept for zoo
+    completeness."""
 
     TYPE = "ELU"
 
@@ -282,8 +283,12 @@ class ELU(Layer):
         return [bottom_shapes[0]]
 
     def apply(self, blobs, bottoms, rng, train):
+        from sparknet_tpu.config.schema import ELUParameter
+
+        p = self.lp.elu_param or ELUParameter()
         x = bottoms[0]
-        return [jnp.where(x > 0, x, jnp.expm1(x))], None
+        alpha = jnp.asarray(p.alpha, x.dtype)
+        return [jnp.where(x > 0, x, alpha * jnp.expm1(x))], None
 
 
 # ---------------------------------------------------------------------------

@@ -166,8 +166,9 @@ def cmd_convert_imageset(args) -> int:
     LISTFILE DB`` — build a DB of Datum records from an image tree + a
     "<relpath> <label>" listfile (reference:
     ``caffe/tools/convert_imageset.cpp``).  ``--backend sndb`` (default)
-    writes the native record format; ``--backend lmdb`` writes a Caffe
-    LMDB through ``io/lmdb.py``."""
+    writes the native record format; ``--backend lmdb`` / ``leveldb``
+    write the Caffe interchange formats through ``io/lmdb.py`` /
+    ``io/leveldb.py``."""
     import os
 
     from PIL import Image
@@ -205,16 +206,49 @@ def cmd_convert_imageset(args) -> int:
         raise SystemExit(
             "images have differing sizes; pass --resize_width/--resize_height"
         )
-    stacked = np.stack(images)
-    if args.backend == "lmdb":
+    _write_backend_db(args.backend, args.db, np.stack(images), labels)
+    print(f"Processed {len(labels)} files.")
+    return 0
+
+
+def _write_backend_db(backend: str, db: str, images, labels) -> None:
+    """One Datum-DB writer dispatch for every converter CLI."""
+    if backend == "lmdb":
         from sparknet_tpu.io import lmdb
 
-        lmdb.write_datum_lmdb(args.db, stacked, labels)
+        lmdb.write_datum_lmdb(db, images, labels)
+    elif backend == "leveldb":
+        from sparknet_tpu.io import leveldb
+
+        leveldb.write_datum_leveldb(db, images, labels)
     else:
         from sparknet_tpu import runtime
 
-        runtime.write_datum_db(args.db, stacked, np.asarray(labels))
-    print(f"Processed {len(labels)} files.")
+        runtime.write_datum_db(db, images, np.asarray(labels))
+
+
+def cmd_convert_mnist(args) -> int:
+    """``convert_mnist IMAGES LABELS DB [--backend B] [--pairs N]`` —
+    idx files -> Datum DB (reference: ``examples/mnist/
+    convert_mnist_data.cpp``); ``--pairs N`` packs N random 2-channel
+    image pairs with same-class labels instead (``examples/siamese/
+    convert_mnist_siamese_data.cpp``)."""
+    from sparknet_tpu.data import mnist
+
+    images = mnist.read_idx_images(args.images)
+    labels = mnist.read_idx_labels(args.labels)
+    if len(images) != len(labels):
+        print(
+            f"convert_mnist: {len(images)} images vs {len(labels)} labels",
+            file=sys.stderr,
+        )
+        return 1
+    if args.pairs:
+        images, labels = mnist.make_pairs(
+            images, labels, args.pairs, seed=args.seed
+        )
+    _write_backend_db(args.backend, args.db, images, labels)
+    print(f"Processed {len(labels)} records.")
     return 0
 
 
@@ -222,12 +256,25 @@ def cmd_compute_image_mean(args) -> int:
     """``compute_image_mean DB [OUTPUT]`` — streaming mean image of a
     Datum DB, written as mean.binaryproto (reference:
     ``caffe/tools/compute_image_mean.cpp``)."""
+    import os
+
     from sparknet_tpu.io import caffemodel, lmdb
 
     total = None
     count = 0
     if lmdb.is_lmdb(args.db):
         it = (img for img, _ in lmdb.read_datum_lmdb(args.db))
+    elif os.path.isdir(args.db):
+        from sparknet_tpu.io import leveldb
+
+        if not leveldb.is_leveldb(args.db):
+            print(
+                f"compute_image_mean: {args.db} is neither an LMDB, a "
+                "LevelDB, nor a record DB",
+                file=sys.stderr,
+            )
+            return 1
+        it = (img for img, _ in leveldb.read_datum_leveldb(args.db))
     else:
         from sparknet_tpu import runtime
         from sparknet_tpu.data.source import _record_shape
@@ -300,12 +347,26 @@ def main(argv=None) -> int:
     p.add_argument("db", help="output DB path")
     p.add_argument("--gray", action="store_true")
     p.add_argument("--shuffle", action="store_true")
-    p.add_argument("--backend", choices=["sndb", "lmdb"], default="sndb")
+    p.add_argument(
+        "--backend", choices=["sndb", "lmdb", "leveldb"], default="sndb"
+    )
     p.add_argument("--resize_width", type=int, default=0)
     p.add_argument("--resize_height", type=int, default=0)
     p.add_argument("--check_size", action="store_true")
     p.add_argument("--seed", type=int, default=0)
     p.set_defaults(fn=cmd_convert_imageset)
+
+    p = sub.add_parser("convert_mnist")
+    p.add_argument("images", help="idx3 image file (.gz ok)")
+    p.add_argument("labels", help="idx1 label file (.gz ok)")
+    p.add_argument("db", help="output DB path")
+    p.add_argument(
+        "--backend", choices=["sndb", "lmdb", "leveldb"], default="sndb"
+    )
+    p.add_argument("--pairs", type=int, default=0,
+                   help="write N siamese 2-channel pairs instead")
+    p.add_argument("--seed", type=int, default=0)
+    p.set_defaults(fn=cmd_convert_mnist)
 
     p = sub.add_parser("compute_image_mean")
     p.add_argument("db")

@@ -1,0 +1,211 @@
+"""Reference example-workflow parity: MNIST idx loading
+(``examples/mnist/convert_mnist_data.cpp``), the siamese LeNet with
+shared towers + ContrastiveLoss (``examples/siamese/``), the R-CNN
+feature model (``models/bvlc_reference_rcnn_ilsvrc13/deploy.prototxt``)
+and Flickr-style fine-tuning (``models/finetune_flickr_style/``)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from sparknet_tpu import models
+from sparknet_tpu.data import mnist
+from sparknet_tpu.net import JaxNet
+from sparknet_tpu.solver import Solver
+
+
+@pytest.fixture(scope="module")
+def mnist_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("mnist"))
+    mnist.write_synthetic(d, n_train=512, n_test=128, seed=0)
+    return d
+
+
+def test_idx_roundtrip_and_gz(tmp_path, mnist_dir):
+    images, labels = mnist.load_mnist(mnist_dir, train=True)
+    assert images.shape == (512, 1, 28, 28) and images.dtype == np.uint8
+    assert labels.shape == (512,) and set(labels) <= set(range(10))
+
+    # .gz copies load transparently (the reference downloads gzipped)
+    import gzip
+
+    src = os.path.join(mnist_dir, mnist.TEST_IMAGES)
+    gz_dir = tmp_path / "gz"
+    gz_dir.mkdir()
+    with open(src, "rb") as f, gzip.open(
+        gz_dir / (mnist.TEST_IMAGES + ".gz"), "wb"
+    ) as g:
+        g.write(f.read())
+    with open(os.path.join(mnist_dir, mnist.TEST_LABELS), "rb") as f, gzip.open(
+        gz_dir / (mnist.TEST_LABELS + ".gz"), "wb"
+    ) as g:
+        g.write(f.read())
+    gz_images, gz_labels = mnist.load_mnist(str(gz_dir), train=False)
+    te_images, te_labels = mnist.load_mnist(mnist_dir, train=False)
+    np.testing.assert_array_equal(gz_images, te_images)
+    np.testing.assert_array_equal(gz_labels, te_labels)
+
+    # corrupt magic raises
+    bad = tmp_path / "bad-images"
+    with open(bad, "wb") as f:
+        f.write(b"\x00\x00\x08\x99" + b"\x00" * 12)
+    with pytest.raises(IOError, match="magic"):
+        mnist.read_idx_images(str(bad))
+
+
+def test_convert_mnist_cli_and_pairs(tmp_path, mnist_dir):
+    from sparknet_tpu import runtime
+    from sparknet_tpu.tools import cli
+
+    db = str(tmp_path / "mnist_db")
+    rc = cli.main(
+        [
+            "convert_mnist",
+            os.path.join(mnist_dir, mnist.TRAIN_IMAGES),
+            os.path.join(mnist_dir, mnist.TRAIN_LABELS),
+            db,
+        ]
+    )
+    assert rc == 0
+    with runtime.RecordDB(db) as rdb:
+        assert len(rdb) == 512
+
+    # siamese 2-channel pair DB (convert_mnist_siamese_data.cpp role)
+    pair_db = str(tmp_path / "pairs_db")
+    rc = cli.main(
+        [
+            "convert_mnist",
+            os.path.join(mnist_dir, mnist.TRAIN_IMAGES),
+            os.path.join(mnist_dir, mnist.TRAIN_LABELS),
+            pair_db,
+            "--backend",
+            "leveldb",
+            "--pairs",
+            "40",
+        ]
+    )
+    assert rc == 0
+    from sparknet_tpu.io import leveldb
+
+    back = list(leveldb.read_datum_leveldb(pair_db))
+    assert len(back) == 40
+    assert back[0][0].shape == (2, 28, 28)
+    assert set(lab for _, lab in back) <= {0, 1}
+
+
+def test_make_pairs_labels(mnist_dir):
+    images, labels = mnist.load_mnist(mnist_dir, train=True)
+    pairs, same = mnist.make_pairs(images, labels, 200, seed=3)
+    assert pairs.shape == (200, 2, 28, 28) and same.shape == (200,)
+    # ~10 classes -> ~10% same-class pairs; both classes must appear
+    assert 0 < same.sum() < 200
+
+
+def test_siamese_shared_towers_train(mnist_dir):
+    solver = Solver(models.load_model_solver("mnist_siamese"))
+    state = solver.init_state(seed=0)
+
+    # towers share parameters by ParamSpec name: the arrays live once
+    # under the tower-A owner layers (net.cpp:470 semantics) and tower-B
+    # layers reference them — so identical inputs embed identically
+    p = state.params
+    assert "conv1" in p and "conv1_p" not in p  # stored once, no copy
+
+    def tower_gap(st, seed):
+        img = np.random.RandomState(seed).rand(8, 1, 28, 28) * 255
+        dup = np.concatenate([img, img], axis=1).astype(np.float32)
+        blobs = solver.net.forward(
+            st.params, st.stats,
+            {"pair_data": dup, "sim": np.ones(8, np.float32)},
+        )
+        return np.abs(np.asarray(blobs["feat"]) - np.asarray(blobs["feat_p"]))
+
+    assert tower_gap(state, 0).max() == 0.0
+
+    images, labels = mnist.load_mnist(mnist_dir, train=True)
+    tau, batch = 5, 64
+    losses_first = losses_last = None
+    for r in range(6):
+        pairs, same = mnist.make_pairs(images, labels, tau * batch, seed=r)
+        window = {
+            "pair_data": pairs.reshape(tau, batch, 2, 28, 28)
+            .astype(np.float32) * (1.0 / 255.0),
+            "sim": same.reshape(tau, batch).astype(np.float32),
+        }
+        state, losses = solver.step(state, window)
+        if losses_first is None:
+            losses_first = float(np.mean(losses))
+        losses_last = float(np.mean(losses))
+    assert losses_last < losses_first  # contrastive loss is learning
+
+    # sharing must survive training updates (gradients from both towers
+    # accumulate into the single owner array)
+    assert tower_gap(state, 1).max() == 0.0
+
+    # embeddings: same-class pairs end up closer than different-class
+    pairs, same = mnist.make_pairs(images, labels, 256, seed=99)
+    blobs = solver.net.forward(
+        state.params,
+        state.stats,
+        {
+            "pair_data": pairs[:100].astype(np.float32) * (1.0 / 255.0),
+            "sim": same[:100].astype(np.float32),
+        },
+    )
+    a, b = np.asarray(blobs["feat"]), np.asarray(blobs["feat_p"])
+    d = np.sqrt(((a - b) ** 2).sum(1))
+    same100 = same[:100].astype(bool)
+    if same100.any() and (~same100).any():
+        assert d[same100].mean() < d[~same100].mean()
+
+
+def test_rcnn_deploy_model(tmp_path):
+    # small-image variant keeps the trunk exact but CPU-friendly
+    netp = models.load_model("rcnn_ilsvrc13", batch=2, image=67, classes=200)
+    net = JaxNet(netp, phase="TEST")
+    assert net.feed_blobs == ["data"]  # deploy model: no label top
+    params, stats = net.init(0)
+    x = np.random.RandomState(0).rand(2, 3, 67, 67).astype(np.float32)
+    blobs = net.forward(params, stats, {"data": x})
+    assert blobs["fc-rcnn"].shape == (2, 200)
+    # featurization tap of an inner blob works the FeaturizerApp way
+    assert blobs["fc7"].shape == (2, 4096)
+    assert not any(n == "loss" for n in blobs)
+
+
+def test_flickr_style_warm_start(tmp_path):
+    from sparknet_tpu.io import caffemodel
+
+    # "train" CaffeNet (tiny image keeps fc6 small), save its weights
+    src = JaxNet(
+        models.load_model("caffenet", batch=2, image=67, classes=1000),
+        phase="TRAIN",
+    )
+    sp, ss = src.init(0)
+    path = str(tmp_path / "caffenet.caffemodel")
+    caffemodel.save_weights(caffemodel.net_blobs(src, sp, ss), path)
+
+    dst = JaxNet(
+        models.load_model("flickr_style", batch=2, image=67), phase="TRAIN"
+    )
+    dp, ds = dst.init(7)
+    before_fc8 = np.asarray(dp["fc8_flickr"][0]).copy()
+    loaded = caffemodel.load_weights(path)
+    dp, ds = caffemodel.apply_blobs(dst, dp, ds, loaded)
+
+    # trunk warm-started from CaffeNet weights...
+    np.testing.assert_array_equal(dp["conv1"][0], sp["conv1"][0])
+    np.testing.assert_array_equal(dp["fc7"][1], sp["fc7"][1])
+    # ...while the renamed head stays freshly initialized (fc8 skipped)
+    np.testing.assert_array_equal(dp["fc8_flickr"][0], before_fc8)
+
+    # the fresh head carries the 10x/20x fine-tuning lr_mult
+    lp = {l.name: l for l in dst.net_param.layer}["fc8_flickr"]
+    assert [s.lr_mult for s in lp.param] == [10.0, 20.0]
+
+
+def test_flickr_style_in_zoo_listing():
+    names = models.available_models()
+    for required in ("flickr_style", "rcnn_ilsvrc13", "mnist_siamese"):
+        assert required in names
