@@ -252,6 +252,39 @@ def cmd_convert_mnist(args) -> int:
     return 0
 
 
+def cmd_upgrade_net_proto_text(args) -> int:
+    """``upgrade_net_proto_text IN OUT`` — rewrite a legacy (V0/V1)
+    net prototxt in the modern format (reference:
+    ``caffe/tools/upgrade_net_proto_text.cpp``; the upgrade passes
+    themselves live in ``config/prototext.py``)."""
+    from sparknet_tpu import config
+    from sparknet_tpu.config import prototext
+
+    netp = config.load_net_prototxt(args.input)  # upgrades on load
+    with open(args.output, "w") as f:
+        f.write(prototext.dumps(netp))
+    print(f"Wrote upgraded net to {args.output}")
+    return 0
+
+
+def cmd_upgrade_solver_proto_text(args) -> int:
+    """``upgrade_solver_proto_text IN OUT`` — rewrite a legacy solver
+    prototxt (enum ``solver_type`` -> string ``type``) in the modern
+    format (reference: ``caffe/tools/upgrade_solver_proto_text.cpp``)."""
+    from sparknet_tpu import config
+    from sparknet_tpu.config import prototext
+    from sparknet_tpu.config.schema import solver_method
+
+    sp = config.load_solver_prototxt(args.input)
+    if sp.solver_type is not None:
+        sp.type = solver_method(sp)
+        sp.solver_type = None
+    with open(args.output, "w") as f:
+        f.write(prototext.dumps(sp))
+    print(f"Wrote upgraded solver to {args.output}")
+    return 0
+
+
 def cmd_compute_image_mean(args) -> int:
     """``compute_image_mean DB [OUTPUT]`` — streaming mean image of a
     Datum DB, written as mean.binaryproto (reference:
@@ -367,6 +400,15 @@ def main(argv=None) -> int:
                    help="write N siamese 2-channel pairs instead")
     p.add_argument("--seed", type=int, default=0)
     p.set_defaults(fn=cmd_convert_mnist)
+
+    for name, fn in (
+        ("upgrade_net_proto_text", cmd_upgrade_net_proto_text),
+        ("upgrade_solver_proto_text", cmd_upgrade_solver_proto_text),
+    ):
+        p = sub.add_parser(name)
+        p.add_argument("input")
+        p.add_argument("output")
+        p.set_defaults(fn=fn)
 
     p = sub.add_parser("compute_image_mean")
     p.add_argument("db")

@@ -1,0 +1,159 @@
+"""Horizontal sibling-conv fusion (default on; SPARKNET_HFUSE=0 opts
+out): the Inception branch convs reading one bottom run as a single
+concatenated-output convolution.  Must be numerically exact vs the
+unfused path in f32, preserve the full blob map, and leave gradients
+identical."""
+
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from sparknet_tpu import models
+from sparknet_tpu.net import JaxNet
+
+
+@pytest.fixture
+def hfuse_env(monkeypatch):
+    monkeypatch.setenv("SPARKNET_HFUSE", "1")
+
+
+def _tiny_googlenet():
+    return models.load_model("googlenet", batch=2, image=64, classes=7)
+
+
+def test_plan_finds_inception_groups(hfuse_env):
+    net = JaxNet(_tiny_googlenet(), phase="TRAIN")
+    assert net._hconv_groups, "no sibling-conv groups found in GoogLeNet"
+    fused_members = sum(
+        len(g["lis"]) for g in net._hconv_groups.values()
+    )
+    # every inception block contributes a >=2-member group (1x1 + the
+    # 3x3/5x5 reduces read the block input with identical 1x1 geometry)
+    assert len(net._hconv_groups) >= 9
+    assert fused_members > len(net._hconv_groups)
+
+
+def test_fused_forward_backward_exact(monkeypatch):
+    netp = _tiny_googlenet()
+    monkeypatch.setenv("SPARKNET_HFUSE", "0")
+    base = JaxNet(netp, phase="TRAIN")
+    monkeypatch.setenv("SPARKNET_HFUSE", "1")
+    fused = JaxNet(netp, phase="TRAIN")
+    assert not base._hconv_groups and fused._hconv_groups
+
+    params, stats = base.init(0)
+    rng = np.random.RandomState(0)
+    batch = {
+        "data": rng.randn(2, 3, 64, 64).astype(np.float32),
+        "label": rng.randint(0, 7, 2).astype(np.float32),
+    }
+
+    out_b = base.apply(params, stats, batch, rng=jax.random.PRNGKey(5))
+    out_f = fused.apply(params, stats, batch, rng=jax.random.PRNGKey(5))
+    np.testing.assert_allclose(
+        float(out_b.loss), float(out_f.loss), rtol=1e-5
+    )
+    # the full named blob map survives fusion (getData parity)
+    assert set(out_b.blobs) == set(out_f.blobs)
+    for name in out_b.blobs:
+        np.testing.assert_allclose(
+            np.asarray(out_b.blobs[name]),
+            np.asarray(out_f.blobs[name]),
+            atol=1e-4,
+            rtol=1e-4,
+            err_msg=name,
+        )
+
+    def loss_fn(net):
+        def f(p):
+            return net.apply(
+                p, stats, batch, rng=jax.random.PRNGKey(5)
+            ).loss
+        return f
+
+    gb = jax.grad(loss_fn(base))(params)
+    gf = jax.grad(loss_fn(fused))(params)
+    flat_b, _ = jax.tree_util.tree_flatten(gb)
+    flat_f, _ = jax.tree_util.tree_flatten(gf)
+    for a, b in zip(flat_b, flat_f):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4, rtol=1e-3
+        )
+
+
+def test_member_top_collision_blocks_fusion(hfuse_env):
+    """A member's top name legally rebound/read by a layer between the
+    leader and the member must block fusion: early production would
+    change what that layer sees."""
+    from sparknet_tpu import config
+
+    NET = """
+    name: "m"
+    layer { name: "data" type: "HostData" top: "x"
+      java_data_param { shape { dim: 2 dim: 3 dim: 8 dim: 8 } } }
+    layer { name: "p" type: "Power" bottom: "x" top: "b"
+      power_param { scale: 2.0 } }
+    layer { name: "ca" type: "Convolution" bottom: "x" top: "a"
+      convolution_param { num_output: 2 kernel_size: 1
+        weight_filler { type: "xavier" } } }
+    layer { name: "q" type: "Power" bottom: "b" top: "q"
+      power_param { shift: 1.0 } }
+    layer { name: "cb" type: "Convolution" bottom: "x" top: "b"
+      convolution_param { num_output: 2 kernel_size: 1
+        weight_filler { type: "xavier" } } }
+    layer { name: "r" type: "Eltwise" bottom: "q" bottom: "q" top: "r" }
+    """
+    net = JaxNet(config.parse_net_prototxt(NET), phase="TRAIN")
+    # cb's top "b" is read by q inside the would-be span -> no fusion
+    assert not net._hconv_groups
+
+    params, stats = net.init(0)
+    x = np.random.RandomState(2).randn(2, 3, 8, 8).astype(np.float32)
+    blobs = net.forward(params, stats, {"x": x})
+    # q must see p's "b" (2*x), not cb's conv output
+    np.testing.assert_allclose(blobs["q"], 2.0 * x + 1.0, atol=1e-5)
+    # and the final "b" is cb's conv output
+    w_b, bias_b = [np.asarray(v) for v in params["cb"]]
+    manual_b = np.einsum(
+        "oc,nchw->nohw", w_b[:, :, 0, 0], x
+    ) + bias_b.reshape(1, -1, 1, 1)
+    np.testing.assert_allclose(blobs["b"], manual_b, atol=1e-5)
+
+
+def test_inplace_bottom_rewrite_blocks_fusion(hfuse_env):
+    """Two convs reading blob X with an in-place ReLU on X between them
+    must NOT fuse (they see different versions of X)."""
+    from sparknet_tpu import config
+
+    NET = """
+    name: "m"
+    layer { name: "data" type: "HostData" top: "x"
+      java_data_param { shape { dim: 2 dim: 3 dim: 8 dim: 8 } } }
+    layer { name: "ca" type: "Convolution" bottom: "x" top: "a"
+      convolution_param { num_output: 2 kernel_size: 1
+        weight_filler { type: "xavier" } } }
+    layer { name: "rx" type: "ReLU" bottom: "x" top: "x" }
+    layer { name: "cb" type: "Convolution" bottom: "x" top: "b"
+      convolution_param { num_output: 2 kernel_size: 1
+        weight_filler { type: "xavier" } } }
+    """
+    net = JaxNet(config.parse_net_prototxt(NET), phase="TRAIN")
+    assert not net._hconv_groups  # in-place rewrite of x blocks fusion
+
+    params, stats = net.init(0)
+    x = np.random.RandomState(1).randn(2, 3, 8, 8).astype(np.float32)
+    blobs = net.forward(params, stats, {"x": x})
+    # ca saw pre-ReLU x, cb saw post-ReLU x — semantics preserved
+    w_a, b_a = [np.asarray(v) for v in params["ca"]]
+    manual_a = np.einsum(
+        "oc,nchw->nohw", w_a[:, :, 0, 0], x
+    ) + b_a.reshape(1, -1, 1, 1)
+    np.testing.assert_allclose(blobs["a"], manual_a, atol=1e-5)
+    w_b, b_b = [np.asarray(v) for v in params["cb"]]
+    manual_b = np.einsum(
+        "oc,nchw->nohw", w_b[:, :, 0, 0], np.maximum(x, 0)
+    ) + b_b.reshape(1, -1, 1, 1)
+    np.testing.assert_allclose(blobs["b"], manual_b, atol=1e-5)

@@ -138,6 +138,42 @@ def test_v0_net_upgrades_and_runs():
     assert np.isfinite(float(out.loss))
 
 
+def test_upgrade_proto_text_cli(tmp_path):
+    """upgrade_net_proto_text / upgrade_solver_proto_text rewrite legacy
+    configs in the modern format (``upgrade_net_proto_text.cpp``,
+    ``upgrade_solver_proto_text.cpp``)."""
+    from sparknet_tpu.tools import cli
+
+    src = tmp_path / "v0.prototxt"
+    src.write_text(V0_NET)
+    out = tmp_path / "modern.prototxt"
+    assert cli.main(
+        ["upgrade_net_proto_text", str(src), str(out)]
+    ) == 0
+    upgraded = config.parse_net_prototxt(out.read_text())
+    assert [l.type for l in upgraded.layer] == [
+        "Convolution", "Pooling", "LRN", "Dropout", "InnerProduct",
+        "SoftmaxWithLoss",
+    ]
+    assert "layers" not in out.read_text().split("{")[0]
+
+    ssrc = tmp_path / "legacy_solver.prototxt"
+    ssrc.write_text(
+        'train_net: "n.prototxt"\nbase_lr: 0.01\nlr_policy: "fixed"\n'
+        "max_iter: 10\nsolver_type: NESTEROV\n"
+    )
+    sout = tmp_path / "modern_solver.prototxt"
+    assert cli.main(
+        ["upgrade_solver_proto_text", str(ssrc), str(sout)]
+    ) == 0
+    text = sout.read_text()
+    assert "solver_type" not in text
+    sp = config.parse_solver_prototxt(text)
+    from sparknet_tpu.config.schema import solver_method
+
+    assert solver_method(sp) == "NESTEROV"
+
+
 def test_v0_unknown_field_raises():
     bad = """
     layers {
