@@ -20,7 +20,7 @@ and warm-start detect the format from the file extension.
 from __future__ import annotations
 
 import os
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import numpy as np
@@ -34,35 +34,130 @@ def _flatten_history(history):
     return leaves, treedef
 
 
-def snapshot(
-    solver: Solver, state: TrainState, prefix: str, fmt: str = None
+def _atomic(write_fn, path: str) -> None:
+    """Write through a temp file + rename so a kill mid-write never
+    leaves a file ``restore()`` would accept."""
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        write_fn(tmp)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def _write_snapshot(
+    fmt: str, prefix: str, it: int, blobs, leaves, net_name: str
 ) -> Tuple[str, str]:
-    """Write model + solver state; returns (model_path, state_path).
-    ``fmt`` overrides ``solver.param.snapshot_format``."""
-    fmt = (fmt or solver.param.snapshot_format or "BINARYPROTO").upper()
-    it = int(jax.device_get(state.iter))
+    """Host-side file writes of one snapshot (shared by the sync path
+    and the AsyncCheckpointer worker); all files publish atomically."""
     os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
-    blobs = caffemodel.net_blobs(solver.net, state.params, state.stats)
-    leaves, _ = _flatten_history(jax.device_get(state.history))
     if fmt == "HDF5":
         from sparknet_tpu.io import hdf5
 
         model_path = f"{prefix}_iter_{it}.caffemodel.h5"
         state_path = f"{prefix}_iter_{it}.solverstate.h5"
-        hdf5.save_weights_hdf5(blobs, model_path)
-        hdf5.save_state_hdf5(state_path, it, [np.asarray(l) for l in leaves])
+        _atomic(lambda p: hdf5.save_weights_hdf5(blobs, p), model_path)
+        _atomic(
+            lambda p: hdf5.save_state_hdf5(
+                p, it, [np.asarray(l) for l in leaves]
+            ),
+            state_path,
+        )
     else:
         model_path = f"{prefix}_iter_{it}.caffemodel"
         state_path = f"{prefix}_iter_{it}.solverstate.npz"
-        caffemodel.save_weights(
-            blobs, model_path, net_name=solver.net.name or "net"
+        _atomic(
+            lambda p: caffemodel.save_weights(blobs, p, net_name=net_name),
+            model_path,
         )
-        np.savez(
-            state_path,
-            iter=np.asarray(it, np.int64),
-            **{f"h{i}": np.asarray(l) for i, l in enumerate(leaves)},
-        )
+
+        def _savez(p):
+            with open(p, "wb") as f:
+                np.savez(
+                    f,
+                    iter=np.asarray(it, np.int64),
+                    **{f"h{i}": np.asarray(l) for i, l in enumerate(leaves)},
+                )
+
+        _atomic(_savez, state_path)
     return model_path, state_path
+
+
+def _host_snapshot_args(solver: Solver, state: TrainState, fmt: str):
+    fmt = (fmt or solver.param.snapshot_format or "BINARYPROTO").upper()
+    it = int(jax.device_get(state.iter))
+    # net_blobs np.asarray()s every blob — the host transfer happens
+    # here, on the caller's thread, against the live buffers
+    blobs = caffemodel.net_blobs(solver.net, state.params, state.stats)
+    leaves = [
+        np.asarray(l)
+        for l in _flatten_history(jax.device_get(state.history))[0]
+    ]
+    return fmt, it, blobs, leaves
+
+
+def snapshot(
+    solver: Solver, state: TrainState, prefix: str, fmt: str = None
+) -> Tuple[str, str]:
+    """Write model + solver state; returns (model_path, state_path).
+    ``fmt`` overrides ``solver.param.snapshot_format``."""
+    fmt, it, blobs, leaves = _host_snapshot_args(solver, state, fmt)
+    return _write_snapshot(
+        fmt, prefix, it, blobs, leaves, solver.net.name or "net"
+    )
+
+
+class AsyncCheckpointer:
+    """Background snapshots for preemption tolerance (the role Orbax
+    async checkpointing plays in TPU stacks; the reference's analog is
+    restart-from-snapshot fault tolerance, SURVEY §5).
+
+    ``save()`` pulls the state to host on the caller's thread (the only
+    part that must see the live buffers — training continues immediately
+    since updates are functional), then serializes and writes on a
+    worker thread.  Files publish atomically, one snapshot is in flight
+    at a time (a new ``save`` waits for the previous write), and worker
+    errors re-raise on the next ``save()``/``wait()``."""
+
+    def __init__(self) -> None:
+        self._thread = None
+        self._exc: Optional[BaseException] = None
+        self._last_paths: Optional[Tuple[str, str]] = None
+
+    def save(
+        self, solver: Solver, state: TrainState, prefix: str, fmt: str = None
+    ) -> None:
+        import threading
+
+        self.wait()
+        fmt, it, blobs, leaves = _host_snapshot_args(solver, state, fmt)
+        net_name = solver.net.name or "net"
+
+        def work():
+            try:
+                self._last_paths = _write_snapshot(
+                    fmt, prefix, it, blobs, leaves, net_name
+                )
+            except BaseException as e:  # noqa: BLE001 — re-raised on wait
+                self._exc = e
+
+        self._thread = threading.Thread(
+            target=work, name="sparknet-async-ckpt", daemon=True
+        )
+        self._thread.start()
+
+    def wait(self) -> Optional[Tuple[str, str]]:
+        """Block until the in-flight snapshot (if any) is published;
+        returns its (model_path, state_path).  Call before process exit
+        and on STOP signals."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise exc
+        return self._last_paths
 
 
 def _load_model_blobs(model_path: str):

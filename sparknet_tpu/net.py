@@ -269,6 +269,10 @@ class JaxNet:
         self._hconv_skip: set = set()
         if os.environ.get("SPARKNET_HFUSE", "1") == "0":
             return
+        # measured on v5e (PERF.md): 3+-way groups (Inception branches)
+        # win +6%; 2-way groups (ResNet stage-entry projection pairs)
+        # LOSE ~4% — the concat/slice overhead beats the tiling gain.
+        min_members = int(os.environ.get("SPARKNET_HFUSE_MIN", "3"))
         groups: Dict[tuple, List[int]] = {}
         for li, layer in enumerate(self.layers):
             lp = layer.lp
@@ -286,7 +290,7 @@ class JaxNet:
             key = (lp.bottom[0], geom, bool(cp.bias_term))
             groups.setdefault(key, []).append(li)
         for key, lis in groups.items():
-            if len(lis) < 2:
+            if len(lis) < min_members:
                 continue
             bottom = key[0]
             # executing every member at the leader's slot must not change
@@ -320,8 +324,10 @@ class JaxNet:
                 "lis": lis,
                 "geom": key[1],
                 "bias": key[2],
+                # each member's own num_output — NOT blob_shapes[top],
+                # which holds the final binding of a possibly-rebound name
                 "sizes": [
-                    self.blob_shapes[self.layers[li].lp.top[0]][1]
+                    self.layers[li].lp.convolution_param.num_output
                     for li in lis
                 ],
             }

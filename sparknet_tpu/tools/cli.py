@@ -82,6 +82,10 @@ def cmd_train(args) -> int:
     max_iter = args.max_iter or solver_param.max_iter or 1000
     snap_every = solver_param.snapshot
     prefix = solver_param.snapshot_prefix or "snapshot"
+    # --async_snapshot: serialization + file writes happen on a worker
+    # thread so the train loop keeps stepping (Orbax-style async
+    # checkpointing; the snapshot itself still publishes atomically)
+    ckpt = checkpoint.AsyncCheckpointer() if args.async_snapshot else None
     while int(jax.device_get(state.iter)) < max_iter:
         batches = (
             sampler.next_window()
@@ -95,12 +99,23 @@ def cmd_train(args) -> int:
         if action == SolverAction.SNAPSHOT or (
             snap_every and it % snap_every < args.tau and it >= snap_every
         ):
-            paths = checkpoint.snapshot(solver, state, prefix)
-            log.log(f"snapshotted to {paths[0]}")
+            if ckpt is not None:
+                ckpt.save(solver, state, prefix)
+                log.log(f"async snapshot started at iter {it}")
+            else:
+                paths = checkpoint.snapshot(solver, state, prefix)
+                log.log(f"snapshotted to {paths[0]}")
         if action == SolverAction.STOP:
             log.log("stop requested; snapshotting and exiting")
-            checkpoint.snapshot(solver, state, prefix)
+            if ckpt is not None:
+                ckpt.save(solver, state, prefix)
+            else:
+                checkpoint.snapshot(solver, state, prefix)
             break
+    if ckpt is not None:
+        paths = ckpt.wait()
+        if paths:
+            log.log(f"final async snapshot: {paths[0]}")
     handler.restore()
     return 0
 
@@ -349,6 +364,8 @@ def main(argv=None) -> int:
     p.add_argument("--tau", type=int, default=10)
     p.add_argument("--max_iter", type=int, default=0)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--async_snapshot", action="store_true",
+                   help="write snapshots on a background thread")
     p.add_argument(
         "--sigint_effect", choices=["stop", "snapshot", "none"], default="stop"
     )

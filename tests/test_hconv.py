@@ -18,6 +18,9 @@ from sparknet_tpu.net import JaxNet
 @pytest.fixture
 def hfuse_env(monkeypatch):
     monkeypatch.setenv("SPARKNET_HFUSE", "1")
+    # guard tests use minimal 2-conv fixtures; production default is 3+
+    # members (2-way groups measured slower on v5e, PERF.md)
+    monkeypatch.setenv("SPARKNET_HFUSE_MIN", "2")
 
 
 def _tiny_googlenet():
@@ -121,6 +124,47 @@ def test_member_top_collision_blocks_fusion(hfuse_env):
         "oc,nchw->nohw", w_b[:, :, 0, 0], x
     ) + bias_b.reshape(1, -1, 1, 1)
     np.testing.assert_allclose(blobs["b"], manual_b, atol=1e-5)
+
+
+def test_later_rebinding_does_not_corrupt_slice_sizes(hfuse_env):
+    """A layer AFTER the fused span that legally rebinds a member's top
+    with a different channel count must not change the group's slice
+    sizes (sizes come from each member's num_output, not the final
+    binding of the name)."""
+    from sparknet_tpu import config
+
+    NET = """
+    name: "m"
+    layer { name: "data" type: "HostData" top: "x"
+      java_data_param { shape { dim: 2 dim: 3 dim: 8 dim: 8 } } }
+    layer { name: "ca" type: "Convolution" bottom: "x" top: "a"
+      convolution_param { num_output: 2 kernel_size: 1
+        weight_filler { type: "xavier" } } }
+    layer { name: "cb" type: "Convolution" bottom: "x" top: "b"
+      convolution_param { num_output: 2 kernel_size: 1
+        weight_filler { type: "xavier" } } }
+    layer { name: "cc" type: "Convolution" bottom: "x" top: "c"
+      convolution_param { num_output: 2 kernel_size: 1
+        weight_filler { type: "xavier" } } }
+    layer { name: "rebind" type: "Convolution" bottom: "b" top: "a"
+      convolution_param { num_output: 5 kernel_size: 1
+        weight_filler { type: "xavier" } } }
+    """
+    net = JaxNet(config.parse_net_prototxt(NET), phase="TRAIN")
+    assert net._hconv_groups
+    (group,) = net._hconv_groups.values()
+    assert group["sizes"] == [2, 2, 2]
+
+    params, stats = net.init(0)
+    x = np.random.RandomState(3).randn(2, 3, 8, 8).astype(np.float32)
+    blobs = net.forward(params, stats, {"x": x})
+    assert blobs["a"].shape == (2, 5, 8, 8)  # final binding: rebind's out
+    assert blobs["b"].shape == (2, 2, 8, 8)
+    w_c, bias_c = [np.asarray(v) for v in params["cc"]]
+    manual_c = np.einsum(
+        "oc,nchw->nohw", w_c[:, :, 0, 0], x
+    ) + bias_c.reshape(1, -1, 1, 1)
+    np.testing.assert_allclose(blobs["c"], manual_c, atol=1e-5)
 
 
 def test_inplace_bottom_rewrite_blocks_fusion(hfuse_env):

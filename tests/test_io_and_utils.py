@@ -75,6 +75,61 @@ def test_caffemodel_roundtrip(tmp_path):
             np.testing.assert_array_equal(a, b)
 
 
+@pytest.mark.parametrize("fmt", ["BINARYPROTO", "HDF5"])
+def test_async_checkpointer_matches_sync(tmp_path, fmt):
+    """AsyncCheckpointer writes the same restorable snapshot as the sync
+    path, keeps training unblocked, and publishes atomically."""
+    s = _solver()
+    st = s.init_state(0)
+    st, _ = s.step(st, _batches(5, 0))
+
+    sync_paths = checkpoint.snapshot(
+        s, st, str(tmp_path / "sync"), fmt=fmt
+    )
+    ckpt = checkpoint.AsyncCheckpointer()
+    ckpt.save(s, st, str(tmp_path / "async"), fmt=fmt)
+    # training continues while the write is in flight
+    st2, _ = s.step(st, _batches(5, 1))
+    model_path, state_path = ckpt.wait()
+    assert os.path.exists(model_path) and os.path.exists(state_path)
+    # no temp files survive the publish
+    assert not [
+        f for f in os.listdir(tmp_path) if ".tmp-" in f
+    ]
+
+    # the async snapshot restores to the exact pre-save state
+    s_sync, s_async = _solver(), _solver()
+    st_sync = checkpoint.restore(s_sync, sync_paths[1])
+    st_async = checkpoint.restore(s_async, state_path)
+    for a, b in zip(
+        jax.tree_util.tree_leaves((st_sync.params, st_sync.history)),
+        jax.tree_util.tree_leaves((st_async.params, st_async.history)),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # ... and continuing from it matches continuing from the live state
+    st_resumed, _ = s_async.step(st_async, _batches(5, 1))
+    np.testing.assert_allclose(
+        np.asarray(st_resumed.params["ip1"][0]),
+        np.asarray(st2.params["ip1"][0]),
+        rtol=1e-6,
+        atol=1e-7,
+    )
+
+
+def test_async_checkpointer_propagates_errors(tmp_path):
+    s = _solver()
+    st = s.init_state(0)
+    ckpt = checkpoint.AsyncCheckpointer()
+    blocked = tmp_path / "not_a_dir"
+    blocked.write_text("file where a directory is needed")
+    ckpt.save(s, st, str(blocked / "prefix"))
+    with pytest.raises(OSError):
+        ckpt.wait()
+    # a failed write leaves the checkpointer usable
+    ckpt.save(s, st, str(tmp_path / "ok"))
+    assert ckpt.wait() is not None
+
+
 def test_mean_image_roundtrip(tmp_path):
     mean = np.random.RandomState(0).rand(3, 32, 32).astype(np.float32)
     path = str(tmp_path / "mean.binaryproto")
