@@ -71,6 +71,69 @@ def _db_layer(netp, phase):
     return None
 
 
+def _hdf5_layer(netp, phase):
+    """The phase's HDF5Data layer (``hdf5_data_layer.cpp`` role)."""
+    from sparknet_tpu.config.schema import NetState
+    from sparknet_tpu.graph import filter_net
+
+    filtered = filter_net(netp, NetState(phase=phase.upper()))
+    for lp in filtered.layer:
+        if lp.type == "HDF5Data" and lp.hdf5_data_param:
+            return lp
+    return None
+
+
+def _hdf5_batches(source, tops, shuffle, net, iterations, phase, seed):
+    """Stacked batches from .h5 files whose datasets are named by the
+    layer tops — concatenated across the listed files, shuffled for
+    TRAIN when the layer asks (``HDF5DataLayer::Next`` semantics),
+    cycled when iterations overrun the data."""
+    import h5py
+
+    from sparknet_tpu.ops.data_layers import hdf5_source_files
+
+    files = hdf5_source_files(source)
+    if not files:
+        raise ValueError(f"HDF5 source {source!r} lists no files")
+    parts = {top: [] for top in tops}
+    for fp in files:
+        with h5py.File(fp, "r") as h:
+            rows = None
+            for top in tops:
+                if top not in h:
+                    raise KeyError(f"{fp} has no dataset {top!r}")
+                arr = np.asarray(h[top])
+                # the reference CHECKs this per file (LoadHDF5FileData)
+                if rows is not None and len(arr) != rows:
+                    raise ValueError(
+                        f"{fp}: dataset {top!r} has {len(arr)} rows, "
+                        f"{tops[0]!r} has {rows}"
+                    )
+                rows = len(arr)
+                parts[top].append(arr)
+    arrays = {
+        top: np.concatenate(p) if len(p) > 1 else p[0]
+        for top, p in parts.items()
+    }
+    n = len(arrays[tops[0]])
+    # the batch of the layer actually being served, not feed_blobs[0]
+    # (another host-fed layer may come first in the net)
+    batch = net.blob_shapes[tops[0]][0]
+    if n < batch:
+        raise ValueError(f"HDF5 source has {n} rows < batch {batch}")
+    order = np.arange(n)
+    if shuffle and phase == "TRAIN":
+        np.random.RandomState(seed).shuffle(order)
+    idx = [
+        np.arange(i * batch, (i + 1) * batch) % n for i in range(iterations)
+    ]
+    out = {}
+    for top in tops:
+        shuffled = arrays[top][order]
+        out[top] = np.stack([shuffled[i].astype(np.float32) for i in idx])
+    return out
+
+
 def _record_shape(db_path, channels, h, w):
     """(C, H, W) of the stored records.  The net only knows the post-crop
     shape; cross-check against the DB's record size and fall back to a
@@ -162,6 +225,13 @@ def resolve_batches(
     """Stacked real batches {feed_blob: (iterations, batch, ...)} for
     ``net`` — see module docstring for the source precedence."""
     db_lp = _db_layer(netp, phase) if netp is not None else None
+    h5_lp = _hdf5_layer(netp, phase) if netp is not None else None
+    if data and h5_lp is not None and data.endswith((".h5", ".hdf5", ".txt")):
+        # a net fed by HDF5Data routes .h5/listfile --data through it
+        return _hdf5_batches(
+            data, list(h5_lp.top), bool(h5_lp.hdf5_data_param.shuffle),
+            net, iterations, phase, seed,
+        )
     if data:
         if os.path.isdir(data):
             import glob
@@ -195,6 +265,16 @@ def resolve_batches(
         return _db_batches(
             db_lp.data_param.source,
             db_lp.transform_param,
+            net,
+            iterations,
+            phase,
+            seed,
+        )
+    if h5_lp is not None and h5_lp.hdf5_data_param.source:
+        return _hdf5_batches(
+            h5_lp.hdf5_data_param.source,
+            list(h5_lp.top),
+            bool(h5_lp.hdf5_data_param.shuffle),
             net,
             iterations,
             phase,

@@ -28,6 +28,8 @@ _NET_FILES = {
     "lenet": "lenet_train_test.prototxt",
     "alexnet": "alexnet_train_val.prototxt",
     "mnist_siamese": "mnist_siamese_train_test.prototxt",
+    "cifar10_quick": "cifar10_quick_train_test.prototxt",
+    "mnist_autoencoder": "mnist_autoencoder.prototxt",
 }
 
 _SOLVER_FILES = {
@@ -38,6 +40,8 @@ _SOLVER_FILES = {
     "googlenet": "googlenet_solver.prototxt",
     "resnet50": "resnet50_solver.prototxt",
     "mnist_siamese": "mnist_siamese_solver.prototxt",
+    "cifar10_quick": "cifar10_quick_solver.prototxt",
+    "mnist_autoencoder": "mnist_autoencoder_solver.prototxt",
 }
 
 

@@ -13,6 +13,7 @@ family.
 
 from __future__ import annotations
 
+import os
 from typing import List
 
 import jax
@@ -20,6 +21,24 @@ import jax.numpy as jnp
 
 from sparknet_tpu.ops import fillers
 from sparknet_tpu.ops.base import Layer, Shape, register
+
+
+def hdf5_source_files(source: str) -> List[str]:
+    """Resolve an HDF5 source to its .h5 file list: either a single
+    .h5/.hdf5 path or (the reference convention) a text listfile of
+    paths, relative entries resolved against the listfile's directory."""
+    if source.endswith((".h5", ".hdf5")):
+        return [source]
+    base = os.path.dirname(os.path.abspath(source))
+    out = []
+    with open(source) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(
+                    line if os.path.isabs(line) else os.path.join(base, line)
+                )
+    return out
 
 
 class _HostFed(Layer):
@@ -99,7 +118,30 @@ class WindowData(_HostFed):
 
 @register
 class HDF5Data(_HostFed):
+    """HDF5-file-fed data (reference: ``hdf5_data_layer.cpp`` + the
+    ``examples/hdf5_classification`` workflow): ``source`` is a text
+    file listing .h5 files whose datasets are named by this layer's
+    tops.  Shapes resolve from the first listed file, like the
+    reference's ``LoadHDF5FileData``; batches are served host-side by
+    ``data/source.py``."""
+
     TYPE = "HDF5Data"
+
+    def declared_shapes(self):
+        p = self.lp.hdf5_data_param
+        if not (p and p.source and p.batch_size):
+            return None
+        if not os.path.isfile(p.source):
+            return None
+        files = hdf5_source_files(p.source)
+        if not files:
+            return None
+        import h5py
+
+        with h5py.File(files[0], "r") as h:
+            return [
+                (p.batch_size,) + tuple(h[t].shape[1:]) for t in self.lp.top
+            ]
 
 
 @register

@@ -129,7 +129,7 @@ SPECS = {
         proto='type: "Flatten"', mode="grad",
         bottoms=lambda: [R.randn(2, 3, 4)],
     ),
-    "HDF5Data": dict(mode="source", reason="file-fed; test_io_and_utils"),
+    "HDF5Data": dict(mode="source", reason="file-fed; test_examples hdf5"),
     "HDF5Output": dict(mode="source", reason="sink; host-side writer tap"),
     "HingeLoss": dict(
         proto='type: "HingeLoss"', mode="grad", atol=2e-3,
