@@ -71,6 +71,19 @@ def load_solver_prototxt_with_net(
     return solver
 
 
+def resolve_solver_net(solver: SolverParameter) -> NetParameter:
+    """The solver's net definition, whichever field carries it (inline
+    ``net_param``/``train_net_param`` or the ``net``/``train_net`` file
+    path) — ``Solver::InitTrainNet``'s resolution order."""
+    netp = solver.net_param or solver.train_net_param
+    if netp is not None:
+        return netp
+    path = solver.net or solver.train_net
+    if path is None:
+        raise ValueError("solver has no net definition")
+    return load_net_prototxt(path)
+
+
 def replace_data_layers(
     net: NetParameter,
     train_batch_shapes,

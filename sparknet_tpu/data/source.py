@@ -58,29 +58,32 @@ def _cifar_batches(data_dir, net, iterations, phase, seed):
     return out
 
 
-def _db_layer(netp, phase):
-    """The phase's Data layer with a DB source, using the real NetState
-    rule filtering (include/exclude/legacy phase — graph.filter_net)."""
+def _phase_layer(netp, phase, type_name, predicate):
+    """First layer of ``type_name`` satisfying ``predicate`` in the
+    phase's view, using the real NetState rule filtering
+    (include/exclude/legacy phase — graph.filter_net)."""
     from sparknet_tpu.config.schema import NetState
     from sparknet_tpu.graph import filter_net
 
     filtered = filter_net(netp, NetState(phase=phase.upper()))
     for lp in filtered.layer:
-        if lp.type == "Data" and lp.data_param and lp.data_param.source:
+        if lp.type == type_name and predicate(lp):
             return lp
     return None
+
+
+def _db_layer(netp, phase):
+    """The phase's Data layer with a DB source."""
+    return _phase_layer(
+        netp, phase, "Data", lambda lp: lp.data_param and lp.data_param.source
+    )
 
 
 def _hdf5_layer(netp, phase):
     """The phase's HDF5Data layer (``hdf5_data_layer.cpp`` role)."""
-    from sparknet_tpu.config.schema import NetState
-    from sparknet_tpu.graph import filter_net
-
-    filtered = filter_net(netp, NetState(phase=phase.upper()))
-    for lp in filtered.layer:
-        if lp.type == "HDF5Data" and lp.hdf5_data_param:
-            return lp
-    return None
+    return _phase_layer(
+        netp, phase, "HDF5Data", lambda lp: lp.hdf5_data_param is not None
+    )
 
 
 def _hdf5_batches(source, tops, shuffle, net, iterations, phase, seed):

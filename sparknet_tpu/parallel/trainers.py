@@ -335,6 +335,12 @@ class AllReduceTrainer:
         st = self.solver.init_state(seed)
         return jax.device_put(st, self._state_shardings)
 
+    def shard_state(self, state: TrainState) -> TrainState:
+        """Place an existing (host or single-device) TrainState onto the
+        mesh — the resume/warm-start entry (``Solver::Restore`` before
+        ``P2PSync::Run``, tools/caffe.cpp:207-216)."""
+        return jax.device_put(state, self._state_shardings)
+
     def step(self, state: TrainState, batches: Dict[str, jax.Array], rng=None):
         """tau synchronous steps on a globally-sharded batch
         (batches[blob]: (tau, global_B, ...))."""

@@ -175,12 +175,12 @@ class Solver:
         self.param = param
         self.compute_dtype = compute_dtype
         self.method = solver_method(param)
-        netp = net_param or param.net_param or param.train_net_param
-        if netp is None:
-            path = param.net or param.train_net
-            if path is None:
-                raise ValueError("solver has no net definition")
-            netp = load_net_prototxt(path)
+        if net_param is not None:
+            netp = net_param
+        else:
+            from sparknet_tpu.config import resolve_solver_net
+
+            netp = resolve_solver_net(param)
         self.net_param = netp
         self.net = JaxNet(
             netp,

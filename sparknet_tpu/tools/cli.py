@@ -38,6 +38,28 @@ def _synthetic_batches(net, tau: int, seed: int = 0) -> Dict[str, np.ndarray]:
     return synthetic_batches(net, tau, seed)
 
 
+def _declared_feed_shapes(netp, phase):
+    """Declared data-layer shapes for one phase view, straight from the
+    config (no net build): the first host-fed layer that can state its
+    shapes, or None."""
+    from sparknet_tpu.config.schema import NetState
+    from sparknet_tpu.graph import filter_net
+    from sparknet_tpu.ops import data_layers as dl
+    from sparknet_tpu.ops.base import create_layer
+
+    filtered = filter_net(netp, NetState(phase=phase))
+    for lp in filtered.layer:
+        try:
+            layer = create_layer(lp, phase)
+        except Exception:
+            continue
+        if isinstance(layer, dl._HostFed):
+            shapes = layer.declared_shapes()
+            if shapes:
+                return [tuple(s) for s in shapes]
+    return None
+
+
 def cmd_train(args) -> int:
     import jax
 
@@ -48,14 +70,59 @@ def cmd_train(args) -> int:
     from sparknet_tpu.utils import SignalHandler, SolverAction, TrainingLog
 
     solver_param = config.load_solver_prototxt(args.solver)
-    solver = Solver(solver_param)
+    trainer = None
+    if args.devices > 1:
+        # the `caffe train --gpu=0,1,...` analog (tools/caffe.cpp:213-216
+        # spins P2PSync): synchronous gradient allreduce over a dp mesh.
+        # Reference semantics: the config's batch_size is per-device, the
+        # effective batch is batch * devices (caffe/docs/multigpu.md).
+        from sparknet_tpu.config import replace_data_layers
+        from sparknet_tpu.parallel import AllReduceTrainer, make_mesh
+
+        n = args.devices
+        if len(jax.devices()) < n:
+            print(
+                f"train: --devices={n} but jax sees "
+                f"{len(jax.devices())} device(s)",
+                file=sys.stderr,
+            )
+            return 1
+        netp0 = config.resolve_solver_net(solver_param)
+        train_shapes = _declared_feed_shapes(netp0, "TRAIN")
+        test_shapes = _declared_feed_shapes(netp0, "TEST") or train_shapes
+        if train_shapes is None:
+            print(
+                "train: --devices needs data layers with declared shapes "
+                "(HostData/Input/MemoryData)",
+                file=sys.stderr,
+            )
+            return 1
+        # reference semantics: training batch scales by device count,
+        # the TEST view keeps the config's own batch (caffe's --gpu
+        # multiplies the training batch only, docs/multigpu.md)
+        scaled = [(s[0] * n,) + tuple(s[1:]) for s in train_shapes]
+        netp = replace_data_layers(netp0, scaled, test_shapes)
+        solver = Solver(solver_param, net_param=netp)
+        mesh = make_mesh({"dp": n}, devices=jax.devices()[:n])
+        trainer = AllReduceTrainer(solver, mesh)
+        print(f"allreduce data-parallel over {n} devices")
+    else:
+        solver = Solver(solver_param)
     if args.snapshot:
         state = checkpoint.restore(solver, args.snapshot)
+        if trainer is not None:
+            state = trainer.shard_state(state)
         print(f"resumed from {args.snapshot} at iter {int(state.iter)}")
     else:
-        state = solver.init_state(seed=args.seed)
+        state = (
+            trainer.init_state(seed=args.seed)
+            if trainer is not None
+            else solver.init_state(seed=args.seed)
+        )
         if args.weights:
             state = checkpoint.load_weights_into_state(solver, state, args.weights)
+            if trainer is not None:
+                state = trainer.shard_state(state)
             print(f"warm-started weights from {args.weights}")
 
     effects = {
@@ -92,7 +159,10 @@ def cmd_train(args) -> int:
             if sampler
             else _synthetic_batches(solver.net, args.tau)
         )
-        state, _ = solver.step(state, batches)
+        if trainer is not None:
+            state, _ = trainer.step(state, batches)
+        else:
+            state, _ = solver.step(state, batches)
         it = int(jax.device_get(state.iter))
         log.log(f"iter {it} smoothed_loss {solver.smoothed_loss:.4f}")
         action = handler.get_action()
@@ -366,6 +436,10 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--async_snapshot", action="store_true",
                    help="write snapshots on a background thread")
+    p.add_argument("--devices", type=int, default=1,
+                   help="N>1: synchronous allreduce DP over the first N "
+                   "local devices (the caffe train --gpu=0,..,N-1 analog; "
+                   "batch_size is per-device)")
     p.add_argument(
         "--sigint_effect", choices=["stop", "snapshot", "none"], default="stop"
     )

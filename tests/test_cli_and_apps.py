@@ -144,3 +144,68 @@ def test_resolve_batches_db_transform_crop(tmp_path, cifar_dir):
     )
     out = resolve_batches(net, netp, None, 2, phase="TEST")
     assert out["data"].shape == (2, 10, 3, 28, 28)
+
+
+def test_cli_train_devices_allreduce(tmp_path, toy_model, cifar_dir, capsys):
+    """`train --devices=N` is the `caffe train --gpu=0,..,N-1` analog
+    (tools/caffe.cpp:213-216 P2PSync): allreduce DP over N local devices
+    with per-device batch semantics, snapshot/resume included."""
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(
+        f'net: "{toy_model}"\n'
+        'base_lr: 0.05\nlr_policy: "fixed"\nmomentum: 0.9\n'
+        "max_iter: 20\nsnapshot: 20\n"
+        f'snapshot_prefix: "{tmp_path}/dp"\n'
+    )
+    rc = cli.main(
+        [
+            "train",
+            f"--solver={solver}",
+            "--devices=2",
+            f"--data={cifar_dir}",
+            "--tau=5",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr()
+    assert "allreduce data-parallel over 2 devices" in out.out
+    snaps = [f for f in os.listdir(tmp_path) if f.endswith(".solverstate.npz")]
+    assert snaps, "no snapshot written"
+
+    # resume the sharded run from the snapshot
+    rc = cli.main(
+        [
+            "train",
+            f"--solver={solver}",
+            "--devices=2",
+            f"--data={cifar_dir}",
+            "--tau=5",
+            f"--snapshot={tmp_path}/{snaps[0]}",
+            "--max_iter=30",
+        ]
+    )
+    assert rc == 0
+    assert "resumed from" in capsys.readouterr().out
+
+
+def test_cli_train_devices_exceeding_available(tmp_path, toy_model, capsys):
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(
+        f'net: "{toy_model}"\nbase_lr: 0.01\nlr_policy: "fixed"\nmax_iter: 5\n'
+    )
+    rc = cli.main(["train", f"--solver={solver}", "--devices=64"])
+    assert rc == 1
+    assert "jax sees" in capsys.readouterr().err
+
+
+def test_declared_feed_shapes_per_phase():
+    """--devices scaling derives shapes from the config per phase: the
+    lenet train/test data layers declare different batches, and only the
+    TRAIN one is scaled (caffe --gpu semantics, docs/multigpu.md)."""
+    from sparknet_tpu import models
+
+    netp = models.load_model("lenet")
+    train = cli._declared_feed_shapes(netp, "TRAIN")
+    test = cli._declared_feed_shapes(netp, "TEST")
+    assert train[0] == (64, 1, 28, 28) and train[1] == (64,)
+    assert test[0] == (100, 1, 28, 28) and test[1] == (100,)

@@ -359,3 +359,60 @@ def test_hdf5_classification_e2e(tmp_path):
     )
     scores = solver.test_and_store_result(state, eval_b)
     assert scores["accuracy"] / 6 > 0.9  # separable -> near-perfect
+
+
+def test_net_surgery_fc_to_conv():
+    """``examples/net_surgery.ipynb`` workflow: fc layers of a trained
+    classifier cast to convolutions compute identical scores at the
+    training size and a dense score map on larger inputs."""
+    import jax
+
+    from sparknet_tpu.config import replace_data_layers
+    from sparknet_tpu.tools.net_surgery import fc_to_conv
+
+    netp = models.load_model("rcnn_ilsvrc13", batch=1, image=99, classes=11)
+    net = JaxNet(netp, phase="TEST")
+    params, stats = net.init(0)
+    assert net.blob_shapes["pool5"] == (1, 256, 2, 2)  # fc6 kernel: 2x2
+
+    rename = {"fc6": "fc6-conv", "fc7": "fc7-conv", "fc-rcnn": "fc-rcnn-conv"}
+    conv_netp, conv_params = fc_to_conv(
+        netp, net.blob_shapes, params, list(rename), rename=rename
+    )
+    by_name = {l.name: l for l in conv_netp.layer}
+    assert by_name["fc6-conv"].type == "Convolution"
+    assert by_name["fc6-conv"].convolution_param.kernel_size == [2]
+    assert by_name["fc7-conv"].convolution_param.kernel_size == [1]
+
+    conv_net = JaxNet(conv_netp, phase="TEST")
+    x = np.random.RandomState(0).randn(1, 3, 99, 99).astype(np.float32)
+    ref = np.asarray(net.forward(params, stats, {"data": x})["fc-rcnn"])
+    out = np.asarray(
+        conv_net.forward(conv_params, stats, {"data": x})["fc-rcnn-conv"]
+    )
+    assert out.shape == (1, 11, 1, 1)
+    np.testing.assert_allclose(out[:, :, 0, 0], ref, atol=1e-4, rtol=1e-4)
+
+    # the fully-convolutional net slides over a larger image
+    big_netp = replace_data_layers(
+        conv_netp, [(1, 3, 131, 131)], [(1, 3, 131, 131)]
+    )
+    big_net = JaxNet(big_netp, phase="TEST")
+    xb = np.random.RandomState(1).randn(1, 3, 131, 131).astype(np.float32)
+    dense = np.asarray(
+        big_net.forward(conv_params, stats, {"data": xb})["fc-rcnn-conv"]
+    )
+    assert dense.shape[:2] == (1, 11) and dense.shape[2] > 1
+    assert np.isfinite(dense).all()
+
+
+def test_net_surgery_rejects_bad_targets():
+    from sparknet_tpu.tools.net_surgery import fc_to_conv
+
+    netp = models.load_model("rcnn_ilsvrc13", batch=1, image=67)
+    net = JaxNet(netp, phase="TEST")
+    params, _ = net.init(0)
+    with pytest.raises(KeyError):
+        fc_to_conv(netp, net.blob_shapes, params, ["nope"])
+    with pytest.raises(ValueError, match="not InnerProduct"):
+        fc_to_conv(netp, net.blob_shapes, params, ["conv1"])
