@@ -7,7 +7,13 @@ artifact (3,000 iterations on the real chip: chance 8.9% -> 100% test
 accuracy by round 50, smoothed loss 2.3 -> 0.0012); this slow-marked test
 replays a shortened schedule in CI.  Reference schedule being exercised:
 ``caffe/examples/cifar10/cifar10_full_solver.prototxt`` via CifarApp's
-loop (``CifarApp.scala:101-116``)."""
+loop (``CifarApp.scala:101-116``).
+
+``training_log_1785415499109_cifar_quick.txt`` is the companion artifact
+for the COMPLETE ``cifar10_quick`` schedule (all 4,000 iterations, batch
+100, fixed lr — produced by ``tools/run_quick_convergence.py`` on the
+real chip): chance 9.4% -> 100%, stable at smoothed loss ~2e-4 to the
+end of the schedule."""
 
 import re
 
