@@ -86,6 +86,53 @@ def _hdf5_layer(netp, phase):
     )
 
 
+def _window_layer(netp, phase):
+    """The phase's WindowData layer (``window_data_layer.cpp`` role)."""
+    return _phase_layer(
+        netp,
+        phase,
+        "WindowData",
+        lambda lp: lp.window_data_param and lp.window_data_param.source,
+    )
+
+
+def _window_batches(lp, net, iterations, phase, seed):
+    from sparknet_tpu.data.windows import (
+        WindowSampler,
+        effective_window_params,
+    )
+
+    crop, mirror, scale, mean_file, mean_value = effective_window_params(lp)
+    mean = None
+    if mean_file:
+        from sparknet_tpu.io import caffemodel
+
+        mean = caffemodel.load_mean_image(mean_file)
+    elif mean_value:
+        mean = np.asarray(mean_value, np.float32)
+    sampler = WindowSampler(
+        lp.window_data_param,
+        mean=mean,
+        phase=phase,
+        seed=seed,
+        crop_size=crop,
+        mirror=mirror,
+        scale=scale,
+    )
+    xs, ys = [], []
+    for _ in range(iterations):
+        x, y = sampler.next_batch()
+        xs.append(x)
+        ys.append(y)
+    # keyed by the layer's own tops, not feed_blobs order (another
+    # host-fed layer may come first in the net)
+    tops = list(lp.top)
+    out = {tops[0]: np.stack(xs)}
+    if len(tops) > 1:
+        out[tops[1]] = np.stack(ys)
+    return out
+
+
 def _hdf5_batches(source, tops, shuffle, net, iterations, phase, seed):
     """Stacked batches from .h5 files whose datasets are named by the
     layer tops — concatenated across the listed files, shuffled for
@@ -283,6 +330,9 @@ def resolve_batches(
             phase,
             seed,
         )
+    win_lp = _window_layer(netp, phase) if netp is not None else None
+    if win_lp is not None:
+        return _window_batches(win_lp, net, iterations, phase, seed)
     if not allow_synthetic:
         raise ValueError(
             "no data source: pass --data=DIR|DB or give the net a Data "

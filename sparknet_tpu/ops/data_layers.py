@@ -113,7 +113,34 @@ class ImageData(_HostFed):
 
 @register
 class WindowData(_HostFed):
+    """R-CNN region-sampling data (reference: ``window_data_layer.cpp``);
+    batches served host-side by ``data/windows.py WindowSampler`` via
+    ``data/source.py``."""
+
     TYPE = "WindowData"
+
+    def declared_shapes(self):
+        p = self.lp.window_data_param
+        if not (p and p.batch_size):
+            return None
+        from sparknet_tpu.data.windows import (
+            effective_window_params,
+            read_window_file_header,
+        )
+
+        crop = effective_window_params(self.lp)[0]
+        if not crop:
+            return None
+        channels = 3
+        if p.source and os.path.isfile(p.source):
+            try:
+                channels = read_window_file_header(p.source)[0]
+            except Exception:
+                pass  # fall back to 3; the sampler reports file errors
+        return [
+            (p.batch_size, channels, crop, crop),
+            (p.batch_size,),
+        ]
 
 
 @register

@@ -251,7 +251,7 @@ SPECS = {
         proto='type: "Tile" tile_param { axis: 1 tiles: 3 }',
         mode="grad", bottoms=lambda: [R.randn(2, 3)],
     ),
-    "WindowData": dict(mode="source", reason="file-fed region sampler"),
+    "WindowData": dict(mode="source", reason="region sampler; test_windows"),
 }
 
 

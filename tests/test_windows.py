@@ -1,0 +1,212 @@
+"""WindowData / R-CNN region sampling (reference:
+``window_data_layer.cpp``): window_file parsing, fg/bg batch
+composition, context-pad warp geometry, mean handling, and the
+resolve_batches wiring that trains a net straight from a window file."""
+
+import os
+
+import numpy as np
+import pytest
+
+from sparknet_tpu import config
+from sparknet_tpu.config.schema import WindowDataParameter
+from sparknet_tpu.data import windows as W
+
+
+@pytest.fixture()
+def window_dir(tmp_path):
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    entries = []
+    for i in range(3):
+        h, w = 48 + 8 * i, 64
+        arr = rng.randint(0, 255, (h, w, 3), np.uint8)
+        # a bright square "object" at a known place
+        arr[10:30, 12:32] = [250, 10, 10]
+        path = tmp_path / f"im{i}.png"
+        Image.fromarray(arr).save(path)
+        windows = [
+            # class 3 object window (overlap 0.9 -> fg)
+            (3, 0.9, 12, 10, 31, 29),
+            # partial overlap, below both thresholds -> bg
+            (3, 0.2, 30, 25, 60, 45),
+            # zero-overlap background
+            (0, 0.0, 40, 2, 62, 20),
+        ]
+        entries.append(
+            f"# {i}\n{path}\n3\n{h}\n{w}\n{len(windows)}\n"
+            + "\n".join(
+                f"{c} {ov} {x1} {y1} {x2} {y2}"
+                for c, ov, x1, y1, x2, y2 in windows
+            )
+        )
+    wf = tmp_path / "window_file.txt"
+    wf.write_text("\n".join(entries) + "\n")
+    return str(wf)
+
+
+def _param(window_file, **kw):
+    defaults = dict(
+        source=window_file,
+        batch_size=16,
+        crop_size=24,
+        fg_threshold=0.5,
+        bg_threshold=0.5,
+        fg_fraction=0.25,
+        context_pad=0,
+        crop_mode="warp",
+    )
+    defaults.update(kw)
+    return WindowDataParameter(**defaults)
+
+
+def test_parse_window_file(window_dir):
+    images = W.parse_window_file(window_dir)
+    assert len(images) == 3
+    assert images[0].channels == 3
+    assert images[1].height == 56 and images[1].width == 64
+    assert images[0].windows.shape == (3, 6)
+    assert images[0].windows[0][1] == 0.9
+
+
+def test_fg_bg_composition_and_labels(window_dir):
+    sampler = W.WindowSampler(_param(window_dir), seed=0)
+    assert len(sampler.fg) == 3 and len(sampler.bg) == 6
+    data, labels = sampler.next_batch()
+    assert data.shape == (16, 3, 24, 24)
+    # exactly batch*fg_fraction foreground samples, labeled 3; the rest
+    # background labeled 0 (window_data_layer.cpp:262-266)
+    assert (labels == 3).sum() == 4
+    assert (labels == 0).sum() == 12
+    # fg crops contain the bright red object
+    fg_mean_r = data[labels == 3][:, 0].mean()
+    bg_mean_r = data[labels == 0][:, 0].mean()
+    assert fg_mean_r > bg_mean_r
+
+
+def test_context_pad_geometry(window_dir):
+    # context_pad expands the region; out-of-image overhang stays at the
+    # zeroed padding value
+    sampler = W.WindowSampler(
+        _param(window_dir, context_pad=8, batch_size=4, fg_fraction=1.0),
+        seed=1,
+    )
+    img = sampler._image(0)
+    # a window at the very top-left corner: expansion must overhang
+    out, pad_h, pad_w, (wh, ww) = sampler._crop_window(
+        img, 0, 0, 19, 19, do_mirror=False
+    )
+    assert out.shape == (24, 24, 3)
+    assert pad_h > 0 and pad_w > 0  # overhang became padding
+    assert np.all(out[:pad_h] == 0) and np.all(out[:, :pad_w] == 0)
+    # context_scale = 24/(24-16) = 3: a 20px window expands to ~60px
+    sampler2 = W.WindowSampler(
+        _param(window_dir, context_pad=0, batch_size=4, fg_fraction=1.0),
+        seed=1,
+    )
+    out2, pad_h2, pad_w2, _ = sampler2._crop_window(
+        img, 0, 0, 19, 19, do_mirror=False
+    )
+    assert pad_h2 == 0 and pad_w2 == 0  # no context: plain warp
+
+
+def test_square_mode_and_mean_values(window_dir):
+    p = _param(
+        window_dir, crop_mode="square", batch_size=8, mirror=True,
+        scale=0.5,
+    )
+    sampler = W.WindowSampler(
+        p, mean=np.asarray([100.0, 50.0, 25.0]), phase="TRAIN", seed=2
+    )
+    data, labels = sampler.next_batch()
+    assert data.shape == (8, 3, 24, 24)
+    assert np.isfinite(data).all()
+    # mean-subtracted and scaled: values live in [-128, 128] ballpark
+    assert data.min() < 0 and data.max() <= (255.0 - 25.0) * 0.5 + 1e-5
+
+
+def test_transform_param_carries_crop_like_reference(window_dir):
+    """The canonical R-CNN prototxt (finetune_pascal_detection) puts
+    crop_size/mirror/mean in transform_param, not window_data_param —
+    both locations must work."""
+    from sparknet_tpu.net import JaxNet
+
+    NET = f"""
+    name: "ft"
+    layer {{ name: "data" type: "WindowData" top: "data" top: "label"
+      transform_param {{ mirror: true crop_size: 28 mean_value: 120 }}
+      window_data_param {{
+        source: "{window_dir}" batch_size: 6 fg_fraction: 0.5
+        context_pad: 4
+      }} }}
+    layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "logits"
+      inner_product_param {{ num_output: 4 weight_filler {{ type: "xavier" }} }} }}
+    layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "logits" bottom: "label" top: "loss" }}
+    """
+    netp = config.parse_net_prototxt(NET)
+    net = JaxNet(netp, phase="TRAIN")
+    assert net.blob_shapes["data"] == (6, 3, 28, 28)
+
+    from sparknet_tpu.data import source
+
+    batches = source.resolve_batches(net, netp, None, iterations=2,
+                                     phase="TRAIN")
+    assert batches["data"].shape == (2, 6, 3, 28, 28)
+    # mean_value applied: data is centered, not raw uint8
+    assert batches["data"].min() < 0
+
+
+def test_window_file_header_fast_path(window_dir):
+    assert W.read_window_file_header(window_dir) == (3, 48, 64)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="not a window file"):
+        W.read_window_file_header(__file__)
+
+
+def test_resolve_batches_window_source(window_dir):
+    from sparknet_tpu.data import source
+    from sparknet_tpu.net import JaxNet
+    from sparknet_tpu.solver import Solver
+
+    NET = f"""
+    name: "rcnn_ft"
+    layer {{ name: "data" type: "WindowData" top: "data" top: "label"
+      window_data_param {{
+        source: "{window_dir}" batch_size: 8 crop_size: 24
+        fg_threshold: 0.5 bg_threshold: 0.5 fg_fraction: 0.25
+        context_pad: 4 crop_mode: "warp"
+      }} }}
+    layer {{ name: "conv" type: "Convolution" bottom: "data" top: "conv"
+      convolution_param {{ num_output: 4 kernel_size: 5 stride: 2
+        weight_filler {{ type: "xavier" }} }} }}
+    layer {{ name: "relu" type: "ReLU" bottom: "conv" top: "conv" }}
+    layer {{ name: "ip" type: "InnerProduct" bottom: "conv" top: "logits"
+      inner_product_param {{ num_output: 4 weight_filler {{ type: "xavier" }} }} }}
+    layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "logits" bottom: "label" top: "loss" }}
+    """
+    netp = config.parse_net_prototxt(NET)
+    solver = Solver(
+        config.parse_solver_prototxt(
+            'base_lr: 0.01 lr_policy: "fixed" momentum: 0.9'
+        ),
+        net_param=netp,
+    )
+    # shapes resolved from window_data_param (+ channels from the file)
+    assert solver.net.blob_shapes["data"] == (8, 3, 24, 24)
+
+    batches = source.resolve_batches(
+        solver.net, netp, None, iterations=6, phase="TRAIN"
+    )
+    assert batches["data"].shape == (6, 8, 3, 24, 24)
+    assert set(np.unique(batches["label"])) <= {0.0, 3.0}
+
+    state = solver.init_state(seed=0)
+    first = last = None
+    for r in range(4):
+        state, losses = solver.step(state, batches)
+        if first is None:
+            first = float(np.mean(losses))
+        last = float(np.mean(losses))
+    assert np.isfinite(last) and last < first  # fg/bg separable
