@@ -86,6 +86,106 @@ def _hdf5_layer(netp, phase):
     )
 
 
+def _image_layer(netp, phase):
+    """The phase's ImageData layer (``image_data_layer.cpp`` role)."""
+    return _phase_layer(
+        netp,
+        phase,
+        "ImageData",
+        lambda lp: lp.image_data_param and lp.image_data_param.source,
+    )
+
+
+def _image_batches(lp, net, iterations, phase, seed):
+    """Batches from an ImageData listfile: load + optional force-resize
+    (new_height/new_width), shuffle when asked, then the standard
+    DataTransformer (crop/mirror/mean/scale) — ``image_data_layer.cpp``
+    load_batch semantics, cycled when iterations overrun the list."""
+    from PIL import Image
+
+    from sparknet_tpu.data.transformer import DataTransformer
+    from sparknet_tpu.io import caffemodel
+
+    p = lp.image_data_param
+    entries = []
+    with open(p.source) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                name, label = line.rsplit(None, 1)
+                entries.append((name, int(label)))
+    if not entries:
+        raise ValueError(f"ImageData source {p.source!r} lists no images")
+    if p.shuffle and phase == "TRAIN":
+        np.random.RandomState(seed).shuffle(entries)
+    if p.rand_skip:
+        skip = np.random.RandomState(seed).randint(p.rand_skip)
+        entries = entries[skip:] + entries[:skip]
+
+    # effective transform: merge the legacy ImageDataParameter copies
+    # into transform_param fields (SAME precedence declared_shapes uses,
+    # so the served shape always matches the declared one)
+    from sparknet_tpu.config.schema import TransformationParameter
+
+    tp = lp.transform_param or TransformationParameter()
+    eff = TransformationParameter(
+        crop_size=tp.crop_size or p.crop_size,
+        mirror=bool(tp.mirror) or bool(p.mirror),
+        scale=tp.scale if tp.scale != 1.0 else p.scale,
+        mean_value=list(tp.mean_value),
+    )
+    mean = None
+    if tp.mean_file:
+        mean = caffemodel.load_mean_image(tp.mean_file)
+    elif p.mean_file:  # legacy location on ImageDataParameter
+        mean = caffemodel.load_mean_image(p.mean_file)
+    transformer = DataTransformer(
+        eff, phase=phase, mean_image=mean, seed=seed
+    )
+
+    # decode lazily: only the entries the requested batches will touch
+    # (real listfiles are tens of thousands of images; a short eval must
+    # not decode them all), cached per entry for cycling
+    batch = int(p.batch_size)
+    n = len(entries)
+    decoded = {}
+
+    def image(j):
+        if j not in decoded:
+            name, _ = entries[j]
+            img = Image.open(os.path.join(p.root_folder, name))
+            img = img.convert("RGB" if p.is_color else "L")
+            if p.new_height and p.new_width:
+                img = img.resize(
+                    (p.new_width, p.new_height), Image.BILINEAR
+                )
+            arr = np.asarray(img, np.uint8)
+            if arr.ndim == 2:
+                arr = arr[:, :, None]
+            decoded[j] = np.ascontiguousarray(arr.transpose(2, 0, 1))
+        return decoded[j]
+
+    tops = list(lp.top)
+    xs, ys = [], []
+    for i in range(iterations):
+        idx = np.arange(i * batch, (i + 1) * batch) % n
+        imgs = [image(j) for j in idx]
+        shapes = {im.shape for im in imgs}
+        if len(shapes) > 1:
+            raise ValueError(
+                f"ImageData source {p.source!r} mixes image sizes "
+                f"{shapes}; set new_height/new_width to force-resize"
+            )
+        xs.append(transformer(np.stack(imgs)))
+        ys.append(
+            np.asarray([entries[j][1] for j in idx], np.float32)
+        )
+    out = {tops[0]: np.stack(xs)}
+    if len(tops) > 1:
+        out[tops[1]] = np.stack(ys)
+    return out
+
+
 def _window_layer(netp, phase):
     """The phase's WindowData layer (``window_data_layer.cpp`` role)."""
     return _phase_layer(
@@ -333,6 +433,9 @@ def resolve_batches(
     win_lp = _window_layer(netp, phase) if netp is not None else None
     if win_lp is not None:
         return _window_batches(win_lp, net, iterations, phase, seed)
+    img_lp = _image_layer(netp, phase) if netp is not None else None
+    if img_lp is not None:
+        return _image_batches(img_lp, net, iterations, phase, seed)
     if not allow_synthetic:
         raise ValueError(
             "no data source: pass --data=DIR|DB or give the net a Data "

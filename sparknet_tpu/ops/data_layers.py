@@ -108,7 +108,42 @@ class Data(_HostFed):
 
 @register
 class ImageData(_HostFed):
+    """Listfile-fed image data (reference: ``image_data_layer.cpp``:
+    ``source`` is "<relpath> <label>" lines).  Shapes resolve from
+    new_height/new_width (or the first listed image, like the
+    reference's first-image probe); batches served host-side by
+    ``data/source.py``."""
+
     TYPE = "ImageData"
+
+    def declared_shapes(self):
+        p = self.lp.image_data_param
+        if not (p and p.source and p.batch_size):
+            return None
+        channels = 3 if p.is_color else 1
+        tp = self.lp.transform_param
+        crop = int(tp.crop_size) if tp and tp.crop_size else int(p.crop_size)
+        if crop:
+            h = w = crop
+        elif p.new_height and p.new_width:
+            h, w = int(p.new_height), int(p.new_width)
+        else:
+            if not os.path.isfile(p.source):
+                return None
+            try:
+                from PIL import Image
+
+                with open(p.source) as f:
+                    first = next(
+                        l for l in (ln.strip() for ln in f) if l
+                    )
+                name = first.rsplit(None, 1)[0]
+                path = os.path.join(p.root_folder, name)
+                with Image.open(path) as im:
+                    w, h = im.size
+            except Exception:
+                return None
+        return [(p.batch_size, channels, h, w), (p.batch_size,)]
 
 
 @register

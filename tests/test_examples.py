@@ -361,6 +361,71 @@ def test_hdf5_classification_e2e(tmp_path):
     assert scores["accuracy"] / 6 > 0.9  # separable -> near-perfect
 
 
+def test_image_data_layer_source(tmp_path):
+    """ImageData layers (the finetune_flickr_style data source:
+    ``image_data_layer.cpp``) load a "<relpath> <label>" listfile with
+    force-resize, shuffle, and transform_param crop/mirror applied."""
+    from PIL import Image
+
+    from sparknet_tpu import config
+    from sparknet_tpu.data import source
+    from sparknet_tpu.solver import Solver
+
+    root = tmp_path / "imgs"
+    root.mkdir()
+    rng = np.random.RandomState(0)
+    lines = []
+    for i in range(8):
+        h, w = 30 + 2 * (i % 3), 36
+        arr = rng.randint(0, 200, (h, w, 3), np.uint8)
+        arr[:, :, i % 2] += 55  # class-dependent tint
+        Image.fromarray(arr).save(root / f"im{i}.png")
+        lines.append(f"im{i}.png {i % 2}")
+    listfile = tmp_path / "train.txt"
+    listfile.write_text("\n".join(lines) + "\n")
+
+    NET = f"""
+    name: "flickr_ft"
+    layer {{ name: "data" type: "ImageData" top: "data" top: "label"
+      transform_param {{ crop_size: 24 mirror: true mean_value: 110 }}
+      image_data_param {{
+        source: "{listfile}" root_folder: "{root}/" batch_size: 4
+        new_height: 28 new_width: 32 shuffle: true
+      }} }}
+    layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "logits"
+      inner_product_param {{ num_output: 2 weight_filler {{ type: "xavier" }} }} }}
+    layer {{ name: "accuracy" type: "Accuracy" bottom: "logits" bottom: "label" top: "accuracy" }}
+    layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "logits" bottom: "label" top: "loss" }}
+    """
+    netp = config.parse_net_prototxt(NET)
+    solver = Solver(
+        config.parse_solver_prototxt(
+            'base_lr: 0.01 lr_policy: "fixed" momentum: 0.9'
+        ),
+        net_param=netp,
+    )
+    # crop_size wins the declared shape
+    assert solver.net.blob_shapes["data"] == (4, 3, 24, 24)
+
+    batches = source.resolve_batches(
+        solver.net, netp, None, iterations=6, phase="TRAIN"
+    )
+    assert batches["data"].shape == (6, 4, 3, 24, 24)
+    assert batches["data"].min() < 0  # mean_value applied
+    assert set(np.unique(batches["label"])) == {0.0, 1.0}
+
+    state = solver.init_state(seed=0)
+    for _ in range(8):
+        state, losses = solver.step(state, batches)
+    scores = solver.test_and_store_result(
+        state,
+        source.resolve_batches(
+            solver.net, netp, None, iterations=4, phase="TEST"
+        ),
+    )
+    assert scores["accuracy"] / 4 > 0.7  # tint is separable
+
+
 def test_net_surgery_fc_to_conv():
     """``examples/net_surgery.ipynb`` workflow: fc layers of a trained
     classifier cast to convolutions compute identical scores at the

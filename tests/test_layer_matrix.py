@@ -144,7 +144,7 @@ SPECS = {
               "{ kernel_size: 3 stride: 2 pad: 1 }",
         mode="grad", bottoms=lambda: [R.randn(2, 3, 5, 5)],
     ),
-    "ImageData": dict(mode="source", reason="file-fed; test_cli_and_apps"),
+    "ImageData": dict(mode="source", reason="listfile-fed; test_examples"),
     "InfogainLoss": dict(
         proto='type: "InfogainLoss"', mode="grad", atol=2e-3,
         bottoms=lambda: [
