@@ -69,8 +69,18 @@ def main(argv=None) -> int:
     features = np.stack(feats)
     print(f"extracted {args.blob}: {features.shape}")
     if args.out:
-        np.savez(args.out, features=features)
-        print(f"wrote {args.out}")
+        if args.out.endswith((".h5", ".hdf5")):
+            # the HDF5Output layer's role (``hdf5_output_layer.cpp``
+            # writes tapped blobs as named datasets): activation taps
+            # export in the interchange format
+            import h5py
+
+            with h5py.File(args.out, "w") as h:
+                h[args.blob] = features
+            print(f"wrote {args.out} (HDF5, dataset {args.blob!r})")
+        else:
+            np.savez(args.out, features=features)
+            print(f"wrote {args.out}")
     return 0
 
 

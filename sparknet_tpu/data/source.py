@@ -143,27 +143,36 @@ def _image_batches(lp, net, iterations, phase, seed):
         eff, phase=phase, mean_image=mean, seed=seed
     )
 
+    if bool(p.new_height) != bool(p.new_width):
+        # the reference CHECKs both-or-neither (image_data_layer.cpp)
+        raise ValueError(
+            "ImageData: new_height and new_width must be set together"
+        )
+
     # decode lazily: only the entries the requested batches will touch
     # (real listfiles are tens of thousands of images; a short eval must
-    # not decode them all), cached per entry for cycling
+    # not decode them all).  Cache only when batches actually cycle —
+    # otherwise each entry is touched once and caching is pure memory.
     batch = int(p.batch_size)
     n = len(entries)
     decoded = {}
+    cache = iterations * batch > n
 
     def image(j):
-        if j not in decoded:
-            name, _ = entries[j]
-            img = Image.open(os.path.join(p.root_folder, name))
-            img = img.convert("RGB" if p.is_color else "L")
-            if p.new_height and p.new_width:
-                img = img.resize(
-                    (p.new_width, p.new_height), Image.BILINEAR
-                )
-            arr = np.asarray(img, np.uint8)
-            if arr.ndim == 2:
-                arr = arr[:, :, None]
-            decoded[j] = np.ascontiguousarray(arr.transpose(2, 0, 1))
-        return decoded[j]
+        if j in decoded:
+            return decoded[j]
+        name, _ = entries[j]
+        img = Image.open(os.path.join(p.root_folder, name))
+        img = img.convert("RGB" if p.is_color else "L")
+        if p.new_height and p.new_width:
+            img = img.resize((p.new_width, p.new_height), Image.BILINEAR)
+        arr = np.asarray(img, np.uint8)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        arr = np.ascontiguousarray(arr.transpose(2, 0, 1))
+        if cache:
+            decoded[j] = arr
+        return arr
 
     tops = list(lp.top)
     xs, ys = [], []
@@ -172,11 +181,24 @@ def _image_batches(lp, net, iterations, phase, seed):
         imgs = [image(j) for j in idx]
         shapes = {im.shape for im in imgs}
         if len(shapes) > 1:
-            raise ValueError(
-                f"ImageData source {p.source!r} mixes image sizes "
-                f"{shapes}; set new_height/new_width to force-resize"
+            # variable-size images are fine when a crop unifies them
+            # (the reference crops each cv::Mat individually); a mean
+            # IMAGE cannot align to varying sizes, mean_value can
+            if not eff.crop_size:
+                raise ValueError(
+                    f"ImageData source {p.source!r} mixes image sizes "
+                    f"{shapes}; set new_height/new_width or a crop_size"
+                )
+            if mean is not None:
+                raise ValueError(
+                    "ImageData: mean_file needs uniform image sizes; "
+                    "use mean_value or new_height/new_width"
+                )
+            xs.append(
+                np.concatenate([transformer(im[None]) for im in imgs])
             )
-        xs.append(transformer(np.stack(imgs)))
+        else:
+            xs.append(transformer(np.stack(imgs)))
         ys.append(
             np.asarray([entries[j][1] for j in idx], np.float32)
         )

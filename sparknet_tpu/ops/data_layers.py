@@ -121,6 +121,10 @@ class ImageData(_HostFed):
         if not (p and p.source and p.batch_size):
             return None
         channels = 3 if p.is_color else 1
+        if bool(p.new_height) != bool(p.new_width):
+            raise ValueError(
+                "ImageData: new_height and new_width must be set together"
+            )
         tp = self.lp.transform_param
         crop = int(tp.crop_size) if tp and tp.crop_size else int(p.crop_size)
         if crop:

@@ -121,6 +121,23 @@ def test_featurizer_real_data(toy_model, cifar_dir, tmp_path, capsys):
     feats = np.load(out_npz)["features"]
     assert feats.shape == (3, 10, 10)
 
+    # .h5 output exports in the interchange format (HDF5Output role)
+    out_h5 = str(tmp_path / "f.h5")
+    rc = featurizer_app.main(
+        [
+            f"--model={toy_model}",
+            "--blob=logits",
+            f"--data={cifar_dir}",
+            "--batches=3",
+            f"--out={out_h5}",
+        ]
+    )
+    assert rc == 0
+    import h5py
+
+    with h5py.File(out_h5, "r") as h:
+        np.testing.assert_array_equal(np.asarray(h["logits"]), feats)
+
 
 def test_resolve_batches_db_transform_crop(tmp_path, cifar_dir):
     """Data-layer transform_param (crop_size) is honored: stored 32x32

@@ -426,6 +426,51 @@ def test_image_data_layer_source(tmp_path):
     assert scores["accuracy"] / 4 > 0.7  # tint is separable
 
 
+def test_image_data_mixed_sizes_crop_and_checks(tmp_path):
+    """Variable-size images train when crop_size unifies them (per-image
+    crop like the reference); half-set new_height/new_width is rejected
+    (image_data_layer.cpp CHECK)."""
+    from PIL import Image
+
+    from sparknet_tpu import config
+    from sparknet_tpu.data import source
+    from sparknet_tpu.net import JaxNet
+
+    root = tmp_path / "imgs"
+    root.mkdir()
+    rng = np.random.RandomState(1)
+    lines = []
+    for i in range(4):
+        h, w = 26 + 4 * i, 30 + 2 * i  # all >= crop 24
+        Image.fromarray(
+            rng.randint(0, 255, (h, w, 3), np.uint8)
+        ).save(root / f"v{i}.png")
+        lines.append(f"v{i}.png {i % 2}")
+    listfile = tmp_path / "list.txt"
+    listfile.write_text("\n".join(lines) + "\n")
+
+    NET = f"""
+    layer {{ name: "data" type: "ImageData" top: "data" top: "label"
+      transform_param {{ crop_size: 24 }}
+      image_data_param {{ source: "{listfile}" root_folder: "{root}/"
+        batch_size: 4 }} }}
+    layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "logits"
+      inner_product_param {{ num_output: 2 weight_filler {{ type: "xavier" }} }} }}
+    layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "logits" bottom: "label" top: "loss" }}
+    """
+    netp = config.parse_net_prototxt(NET)
+    net = JaxNet(netp, phase="TRAIN")
+    batches = source.resolve_batches(net, netp, None, iterations=2,
+                                     phase="TRAIN")
+    assert batches["data"].shape == (2, 4, 3, 24, 24)
+
+    bad = NET.replace(
+        'batch_size: 4', 'batch_size: 4 new_height: 28'
+    )
+    with pytest.raises(ValueError, match="set together"):
+        JaxNet(config.parse_net_prototxt(bad), phase="TRAIN")
+
+
 def test_net_surgery_fc_to_conv():
     """``examples/net_surgery.ipynb`` workflow: fc layers of a trained
     classifier cast to convolutions compute identical scores at the
