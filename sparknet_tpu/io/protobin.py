@@ -1,0 +1,307 @@
+"""Generic proto2 binary codec for the config schema.
+
+Closes the binary leg of the legacy-upgrade tools
+(``caffe/tools/upgrade_net_proto_binary.cpp``) and gives binary
+NetParameter/SolverParameter I/O in general: ``decode(name, data)``
+binds a serialized message onto the typed dataclass schema
+(``config/schema.py``), ``encode(obj, name)`` writes it back, both
+driven by the field-number tables in ``io/proto_fields.py`` (extracted
+from the wire contract's field declarations; regenerate by re-parsing
+``caffe.proto``'s ``label type name = number`` lines).
+
+Codec rules:
+
+- scalars by proto type: (u)int32/64 + bool -> varint; float ->
+  fixed32; double -> fixed64; string/bytes -> length-delimited;
+- enums decode to their NAME strings (the schema stores enum fields as
+  strings — ``pool: MAX``), resolved ``Message.Enum`` first, then any
+  enum with a matching leaf name;
+- repeated numeric fields accept both packed and unpacked encodings and
+  encode unpacked (proto2's default);
+- V1 ``layers`` entries decode through the ``V1LayerParameter`` table
+  into modern ``LayerParameter`` objects (its enum ``type`` becomes the
+  V1 NAME string that ``config.prototext._upgrade_net`` already
+  converts; its legacy string ``param`` becomes ``ParamSpec.name``);
+- fields with no schema counterpart (layer ``blobs`` weights, V0 nested
+  ``layer``) raise with guidance rather than silently dropping data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Any, Dict, List, Optional
+
+from sparknet_tpu.config import schema
+from sparknet_tpu.io import wire
+from sparknet_tpu.io.proto_fields import ENUMS, FIELDS
+
+# proto message name -> schema class name (identical unless listed)
+_SCHEMA_NAME = {"V1LayerParameter": "LayerParameter"}
+
+_VARINT_TYPES = {"int32", "int64", "uint32", "uint64", "sint32", "sint64",
+                 "bool"}
+
+
+class ProtoBinError(ValueError):
+    pass
+
+
+def _enum_table(msg: str, ftype: str) -> Optional[Dict[int, str]]:
+    if f"{msg}.{ftype}" in ENUMS:
+        return ENUMS[f"{msg}.{ftype}"]
+    if ftype in ENUMS:
+        return ENUMS[ftype]
+    for key, table in ENUMS.items():
+        if key.endswith(f".{ftype}"):
+            return table
+    return None
+
+
+def _schema_cls(proto_msg: str):
+    return getattr(schema, _SCHEMA_NAME.get(proto_msg, proto_msg))
+
+
+def _field_types(cls) -> Dict[str, Any]:
+    return {f.name: f for f in dataclasses.fields(cls)}
+
+
+# ---------------------------------------------------------------------------
+# decode
+# ---------------------------------------------------------------------------
+
+def _scalar_from_wire(msg, ftype, wiretype, value):
+    if ftype in _VARINT_TYPES:
+        if ftype == "bool":
+            return bool(value)
+        v = int(value)
+        if ftype in ("int32", "int64") and v >= 1 << 63:
+            v -= 1 << 64  # negative two's-complement varint
+        return v
+    if ftype in ("float", "double"):
+        return float(value)  # wire.iter_fields already unpacks fixed32/64
+    if ftype == "string":
+        return bytes(value).decode("utf-8")
+    if ftype == "bytes":
+        return bytes(value)
+    table = _enum_table(msg, ftype)
+    if table is not None:
+        v = int(value)
+        if v not in table:
+            raise ProtoBinError(f"{msg}.{ftype}: unknown enum value {v}")
+        return table[v]
+    raise ProtoBinError(f"{msg}: unhandled scalar type {ftype!r}")
+
+
+def _packed_scalars(msg, ftype, data) -> List[Any]:
+    """A packed repeated numeric field (length-delimited payload)."""
+    view = memoryview(bytes(data))
+    out, pos = [], 0
+    while pos < len(view):
+        if ftype == "float":
+            out.append(struct.unpack_from("<f", view, pos)[0])
+            pos += 4
+        elif ftype == "double":
+            out.append(struct.unpack_from("<d", view, pos)[0])
+            pos += 8
+        else:
+            v, pos = wire.decode_varint(view, pos)
+            out.append(_scalar_from_wire(msg, ftype, 0, v))
+    return out
+
+
+def decode(proto_msg: str, data: bytes):
+    """Serialized ``proto_msg`` bytes -> schema object."""
+    if proto_msg not in FIELDS:
+        raise ProtoBinError(f"no field table for message {proto_msg!r}")
+    cls = _schema_cls(proto_msg)
+    table = FIELDS[proto_msg]
+    ftypes = _field_types(cls)
+    obj = cls()
+    for num, wiretype, value in wire.iter_fields(data):
+        if num not in table:
+            continue  # unknown field: proto2 readers skip
+        name, label, ftype = table[num]
+        # fields whose payload the schema cannot carry must not be
+        # silently dropped
+        if proto_msg in ("LayerParameter", "V1LayerParameter") and (
+            name == "blobs"
+        ):
+            raise ProtoBinError(
+                "layer carries weight blobs — this is a weights file; "
+                "use io/caffemodel.py (load_weights) for it"
+            )
+        if proto_msg == "V1LayerParameter" and name == "layer":
+            raise ProtoBinError(
+                "V0-era binary net (nested 'layer' connection messages) "
+                "is not supported; upgrade the text form via "
+                "upgrade_net_proto_text"
+            )
+        if name not in ftypes:
+            continue  # e.g. BlobProto double_data
+        # V1 'param' is the legacy share-name string list -> ParamSpec
+        if proto_msg == "V1LayerParameter" and name == "param":
+            obj.param = list(obj.param) + [
+                schema.ParamSpec(name=bytes(value).decode("utf-8"))
+            ]
+            continue
+        # the schema's shape decides repetition (the fork declares
+        # JavaDataParameter.shape optional but one per top is stored)
+        repeated = label == "repeated" or isinstance(
+            getattr(obj, name), list
+        )
+        if ftype in FIELDS:  # nested message
+            sub_msg = ftype
+            if proto_msg == "NetParameter" and name == "layers":
+                sub_msg = "V1LayerParameter"
+            sub = decode(sub_msg, bytes(value))
+            if repeated:
+                getattr(obj, name).append(sub)
+            else:
+                setattr(obj, name, sub)
+            continue
+        if repeated:
+            cur = list(getattr(obj, name) or [])
+            if wiretype == 2 and ftype not in ("string", "bytes"):
+                cur.extend(_packed_scalars(proto_msg, ftype, value))
+            else:
+                cur.append(
+                    _scalar_from_wire(proto_msg, ftype, wiretype, value)
+                )
+            setattr(obj, name, cur)
+        else:
+            setattr(
+                obj,
+                name,
+                _scalar_from_wire(proto_msg, ftype, wiretype, value),
+            )
+    return obj
+
+
+# ---------------------------------------------------------------------------
+# encode
+# ---------------------------------------------------------------------------
+
+def _scalar_to_wire(msg, ftype, num, value) -> bytes:
+    if ftype in _VARINT_TYPES:
+        v = int(value)
+        if v < 0:
+            v += 1 << 64
+        return wire.field_varint(num, v)
+    if ftype == "float":
+        return wire.tag(num, 5) + struct.pack("<f", float(value))
+    if ftype == "double":
+        return wire.tag(num, 1) + struct.pack("<d", float(value))
+    if ftype == "string":
+        return wire.field_bytes(num, str(value).encode("utf-8"))
+    if ftype == "bytes":
+        return wire.field_bytes(num, bytes(value))
+    table = _enum_table(msg, ftype)
+    if table is not None:
+        rev = {n: i for i, n in table.items()}
+        key = str(value).upper()
+        if key not in rev:
+            raise ProtoBinError(
+                f"{msg}.{ftype}: {value!r} is not one of {sorted(rev)}"
+            )
+        return wire.field_varint(num, rev[key])
+    raise ProtoBinError(f"{msg}: unhandled scalar type {ftype!r}")
+
+
+def encode(obj, proto_msg: str) -> bytes:
+    """Schema object -> serialized ``proto_msg`` bytes (defaults and
+    empty fields omitted, like the text printer)."""
+    if proto_msg not in FIELDS:
+        raise ProtoBinError(f"no field table for message {proto_msg!r}")
+    cls = _schema_cls(proto_msg)
+    defaults = cls()
+    out = bytearray()
+    ftypes = _field_types(cls)
+    for num, (name, label, ftype) in sorted(FIELDS[proto_msg].items()):
+        if name not in ftypes:
+            continue
+        value = getattr(obj, name)
+        if proto_msg == "V1LayerParameter" and name == "param":
+            continue  # modern param encoding only (field 100x is legacy)
+        if proto_msg == "NetParameter" and name == "layers":
+            continue  # always emit the modern 'layer' field
+        if label == "repeated" or isinstance(value, list):
+            for item in value or []:
+                if ftype in FIELDS:
+                    out += wire.field_bytes(num, encode(item, ftype))
+                else:
+                    out += _scalar_to_wire(proto_msg, ftype, num, item)
+            continue
+        if value is None or value == getattr(defaults, name):
+            continue
+        if ftype in FIELDS:
+            out += wire.field_bytes(num, encode(value, ftype))
+        else:
+            out += _scalar_to_wire(proto_msg, ftype, num, value)
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# file-level API (the upgrade_net_proto_binary surface)
+# ---------------------------------------------------------------------------
+
+def _merge_v1_param_multipliers(net: schema.NetParameter) -> None:
+    """V1 layers can carry BOTH legacy share-name strings (decoded into
+    ``ParamSpec.name`` entries) and ``blobs_lr``/``weight_decay`` lists;
+    the reference's UpgradeV1LayerParameter merges them into the same
+    ParamSpec — do that before ``_upgrade_net`` (whose blobs_lr leg only
+    fires when no param entries exist)."""
+    for layer in list(net.layers) + list(net.layer):
+        if not (layer.blobs_lr and layer.param):
+            continue
+        while len(layer.param) < len(layer.blobs_lr):
+            layer.param.append(schema.ParamSpec())
+        for i, lr in enumerate(layer.blobs_lr):
+            layer.param[i].lr_mult = lr
+            if i < len(layer.weight_decay):
+                layer.param[i].decay_mult = layer.weight_decay[i]
+        layer.blobs_lr = []
+        layer.weight_decay = []
+
+
+def load_net_binary(path: str) -> schema.NetParameter:
+    """Binary NetParameter file -> upgraded modern schema object."""
+    from sparknet_tpu.config.prototext import _upgrade_net
+
+    with open(path, "rb") as f:
+        net = decode("NetParameter", f.read())
+    _merge_v1_param_multipliers(net)
+    _upgrade_net(net)
+    return net
+
+
+def save_net_binary(netp: schema.NetParameter, path: str) -> None:
+    with open(path, "wb") as f:
+        f.write(encode(netp, "NetParameter"))
+
+
+def load_solver_binary(path: str) -> schema.SolverParameter:
+    """Binary SolverParameter file -> upgraded modern schema object
+    (embedded nets upgraded like ``load_net_binary``; legacy enum
+    ``solver_type`` folded into string ``type``)."""
+    from sparknet_tpu.config.prototext import _upgrade_net
+    from sparknet_tpu.config.schema import solver_method
+
+    with open(path, "rb") as f:
+        sp = decode("SolverParameter", f.read())
+    for net in (
+        [sp.net_param, sp.train_net_param]
+        + list(sp.test_net_param or [])
+    ):
+        if net is not None:
+            _merge_v1_param_multipliers(net)
+            _upgrade_net(net)
+    if sp.solver_type is not None:
+        sp.type = solver_method(sp)
+        sp.solver_type = None
+    return sp
+
+
+def save_solver_binary(sp: schema.SolverParameter, path: str) -> None:
+    with open(path, "wb") as f:
+        f.write(encode(sp, "SolverParameter"))

@@ -352,6 +352,20 @@ def cmd_upgrade_net_proto_text(args) -> int:
     return 0
 
 
+def cmd_upgrade_net_proto_binary(args) -> int:
+    """``upgrade_net_proto_binary IN OUT`` — rewrite a legacy (V1)
+    *binary* NetParameter in the modern binary format (reference:
+    ``caffe/tools/upgrade_net_proto_binary.cpp``; codec:
+    ``io/protobin.py``).  Weight files are refused with a pointer to
+    the caffemodel importer."""
+    from sparknet_tpu.io import protobin
+
+    netp = protobin.load_net_binary(args.input)  # upgrades on load
+    protobin.save_net_binary(netp, args.output)
+    print(f"Wrote upgraded binary net to {args.output}")
+    return 0
+
+
 def cmd_upgrade_solver_proto_text(args) -> int:
     """``upgrade_solver_proto_text IN OUT`` — rewrite a legacy solver
     prototxt (enum ``solver_type`` -> string ``type``) in the modern
@@ -494,6 +508,7 @@ def main(argv=None) -> int:
 
     for name, fn in (
         ("upgrade_net_proto_text", cmd_upgrade_net_proto_text),
+        ("upgrade_net_proto_binary", cmd_upgrade_net_proto_binary),
         ("upgrade_solver_proto_text", cmd_upgrade_solver_proto_text),
     ):
         p = sub.add_parser(name)

@@ -1,0 +1,154 @@
+"""Binary proto codec (``io/protobin.py`` — the
+``upgrade_net_proto_binary.cpp`` role plus binary NetParameter/
+SolverParameter I/O in general)."""
+
+import numpy as np
+import pytest
+
+from sparknet_tpu import config, models
+from sparknet_tpu.config import prototext, schema
+from sparknet_tpu.io import protobin, wire
+
+
+def test_modern_net_roundtrip_exact():
+    # lenet's values are all f32-exact, so text dumps match bitwise
+    netp = models.load_model("lenet")
+    data = protobin.encode(netp, "NetParameter")
+    back = protobin.decode("NetParameter", data)
+    assert prototext.dumps(netp) == prototext.dumps(back)
+
+
+@pytest.mark.parametrize(
+    "name", ["cifar10_full", "alexnet", "mnist_siamese", "mnist_autoencoder"]
+)
+def test_zoo_nets_roundtrip_fixed_point(name):
+    """Binary floats are 4-byte, so one decimal->f32 rounding happens on
+    first encode; after that the codec must be a fixed point."""
+    netp = models.load_model(name)
+    once = protobin.encode(netp, "NetParameter")
+    back = protobin.decode("NetParameter", once)
+    twice = protobin.encode(back, "NetParameter")
+    assert once == twice
+    # structure survives: same layers, types, and tops
+    assert [(l.name, l.type, tuple(l.top)) for l in netp.layer] == [
+        (l.name, l.type, tuple(l.top)) for l in back.layer
+    ]
+    # floats are f32-rounded, not lost
+    for a, b in zip(netp.layer, back.layer):
+        if a.lrn_param:
+            np.testing.assert_allclose(
+                b.lrn_param.alpha, a.lrn_param.alpha, rtol=1e-7
+            )
+
+
+def test_solver_roundtrip_and_enums():
+    sp = models.load_model_solver("cifar10_quick")
+    sp.net_param = None
+    back = protobin.decode(
+        "SolverParameter", protobin.encode(sp, "SolverParameter")
+    )
+    assert back.snapshot_format == "HDF5"  # enum survives by NAME
+    assert back.base_lr == np.float32(sp.base_lr)
+    assert back.max_iter == sp.max_iter
+    assert back.lr_policy == sp.lr_policy
+
+
+def test_v1_binary_net_upgrades(tmp_path):
+    """A V1-era binary net (NetParameter.layers of V1LayerParameter with
+    enum types, blobs_lr, legacy string param) loads as a modern net —
+    the upgrade_net_proto_binary path."""
+    # hand-build the V1 binary: layers { name type=CONVOLUTION(4)
+    #   bottom/top blobs_lr param convolution_param{num_output kernel} }
+    conv_param = wire.field_varint(1, 3) + wire.field_varint(4, 3)
+    # V1LayerParameter fields: bottom=2 top=3 name=4 type=5 blobs_lr=7
+    # param=1001 convolution_param=10
+    v1_layer = (
+        wire.field_bytes(2, b"data")
+        + wire.field_bytes(3, b"conv1")
+        + wire.field_bytes(4, b"conv1")
+        + wire.field_varint(5, 4)  # LayerType CONVOLUTION
+        + wire.tag(7, 5) + np.float32(1.0).tobytes()
+        + wire.tag(7, 5) + np.float32(2.0).tobytes()
+        + wire.field_bytes(10, conv_param)
+        + wire.field_bytes(1001, b"shared_w")
+    )
+    blob = wire.field_bytes(1, b"v1net") + wire.field_bytes(2, v1_layer)
+    src = tmp_path / "v1.binaryproto"
+    src.write_bytes(blob)
+
+    netp = protobin.load_net_binary(str(src))
+    assert netp.name == "v1net"
+    (layer,) = netp.layer
+    assert layer.type == "Convolution"  # V1 enum -> modern string
+    assert layer.convolution_param.num_output == 3
+    assert layer.convolution_param.kernel_size == [3]
+    # legacy share-name strings and blobs_lr merge into the SAME
+    # ParamSpec entries (UpgradeV1LayerParameter semantics)
+    assert layer.param[0].name == "shared_w"
+    assert [p.lr_mult for p in layer.param] == [1.0, 2.0]
+    assert not layer.blobs_lr
+    assert list(layer.bottom) == ["data"] and list(layer.top) == ["conv1"]
+
+
+def test_solver_binary_upgrades_legacy(tmp_path):
+    """Binary solvers upgrade like nets: legacy enum solver_type folds
+    into type, embedded V1 nets modernize."""
+    v1_layer = (
+        wire.field_bytes(4, b"ip")
+        + wire.field_varint(5, 14)  # V1 LayerType INNER_PRODUCT
+    )
+    embedded = wire.field_bytes(2, v1_layer)  # NetParameter.layers
+    sp_bytes = (
+        wire.field_bytes(25, embedded)  # net_param = 25
+        + wire.field_varint(30, 1)  # solver_type = NESTEROV(1)
+    )
+    p = tmp_path / "legacy.solverstate"
+    p.write_bytes(sp_bytes)
+    sp = protobin.load_solver_binary(str(p))
+    assert sp.solver_type is None and sp.type == "NESTEROV"
+    assert sp.net_param.layer[0].type == "InnerProduct"
+
+
+def test_weight_files_are_refused(tmp_path):
+    # a layer carrying BlobProto weights is a caffemodel, not a net def
+    blob_proto = wire.field_varint(2, 1)  # count-ish field
+    layer = wire.field_bytes(1, b"ip") + wire.field_bytes(7, blob_proto)
+    data = wire.field_bytes(100, layer)  # modern 'layer' field
+    p = tmp_path / "weights.binaryproto"
+    p.write_bytes(data)
+    with pytest.raises(protobin.ProtoBinError, match="caffemodel"):
+        protobin.load_net_binary(str(p))
+
+
+def test_upgrade_net_proto_binary_cli(tmp_path):
+    from sparknet_tpu.tools import cli
+
+    netp = models.load_model("lenet")
+    src = tmp_path / "modern.binaryproto"
+    protobin.save_net_binary(netp, str(src))
+    out = tmp_path / "upgraded.binaryproto"
+    assert cli.main(
+        ["upgrade_net_proto_binary", str(src), str(out)]
+    ) == 0
+    back = protobin.load_net_binary(str(out))
+    assert prototext.dumps(back) == prototext.dumps(netp)
+
+
+def test_packed_repeated_decodes():
+    # packed encoding of repeated numerics (proto3-style writers)
+    packed = b"".join(
+        np.float32(v).tobytes() for v in (0.5, 1.5, 2.5)
+    )
+    lp = wire.field_bytes(1, b"x") + wire.field_bytes(5, packed)
+    layer = protobin.decode("LayerParameter", lp)  # 5 = loss_weight
+    assert layer.loss_weight == [0.5, 1.5, 2.5]
+
+
+def test_negative_varint_roundtrip():
+    # int32 fields carry negatives as 10-byte varints
+    tp = schema.TransformationParameter(crop_size=5)
+    ip = schema.InnerProductParameter(num_output=7, axis=-1)
+    data = protobin.encode(ip, "InnerProductParameter")
+    back = protobin.decode("InnerProductParameter", data)
+    assert back.axis == -1 and back.num_output == 7
+    del tp
