@@ -94,20 +94,16 @@ def _scalar_from_wire(msg, ftype, wiretype, value):
 
 
 def _packed_scalars(msg, ftype, data) -> List[Any]:
-    """A packed repeated numeric field (length-delimited payload)."""
-    view = memoryview(bytes(data))
-    out, pos = [], 0
-    while pos < len(view):
-        if ftype == "float":
-            out.append(struct.unpack_from("<f", view, pos)[0])
-            pos += 4
-        elif ftype == "double":
-            out.append(struct.unpack_from("<d", view, pos)[0])
-            pos += 8
-        else:
-            v, pos = wire.decode_varint(view, pos)
-            out.append(_scalar_from_wire(msg, ftype, 0, v))
-    return out
+    """A packed repeated numeric field — delegates to the shared wire
+    helpers (numpy fast path for float/double)."""
+    if ftype == "float":
+        return [float(v) for v in wire.packed_floats(data, 2)]
+    if ftype == "double":
+        return [float(v) for v in wire.packed_doubles(data)]
+    return [
+        _scalar_from_wire(msg, ftype, 0, v)
+        for v in wire.packed_varints(data)
+    ]
 
 
 def decode(proto_msg: str, data: bytes):
@@ -161,14 +157,16 @@ def decode(proto_msg: str, data: bytes):
                 setattr(obj, name, sub)
             continue
         if repeated:
-            cur = list(getattr(obj, name) or [])
+            cur = getattr(obj, name)
+            if cur is None:
+                cur = []
+                setattr(obj, name, cur)
             if wiretype == 2 and ftype not in ("string", "bytes"):
                 cur.extend(_packed_scalars(proto_msg, ftype, value))
             else:
                 cur.append(
                     _scalar_from_wire(proto_msg, ftype, wiretype, value)
                 )
-            setattr(obj, name, cur)
         else:
             setattr(
                 obj,

@@ -337,6 +337,105 @@ def cmd_convert_mnist(args) -> int:
     return 0
 
 
+def cmd_classify(args) -> int:
+    """``classify --model D.prototxt --weights W.caffemodel [--mean M]
+    [--labels L.txt] [--topk 5] IMAGE...`` — single-image inference with
+    top-k class output (reference: ``examples/cpp_classification/
+    classification.cpp``).  The deploy net's input size drives the
+    resize; mean may be a binaryproto or comma-separated channel
+    values."""
+    import os
+
+    import jax
+    from PIL import Image
+
+    from sparknet_tpu import config, models
+    from sparknet_tpu.io import caffemodel
+    from sparknet_tpu.net import JaxNet
+
+    netp = (
+        config.load_net_prototxt(args.model)
+        if args.model.endswith(".prototxt")
+        else models.load_model(args.model)
+    )
+    net = JaxNet(netp, phase="TEST")
+    if len(net.feed_blobs) > 1:
+        print(
+            "classify: the net wants labels — pass a deploy config "
+            f"(feeds: {net.feed_blobs})",
+            file=sys.stderr,
+        )
+        return 1
+    data_blob = net.feed_blobs[0]
+    _, c, h, w = net.blob_shapes[data_blob]
+    params, stats = net.init(0)
+    if args.weights:
+        params, stats = caffemodel.apply_blobs(
+            net, params, stats, caffemodel.load_weights(args.weights)
+        )
+
+    mean = None
+    if args.mean:
+        if os.path.isfile(args.mean):
+            mean = np.asarray(caffemodel.load_mean_image(args.mean))
+            if mean.ndim == 4:
+                mean = mean[0]
+            if mean.shape[1] < h or mean.shape[2] < w:
+                print(
+                    f"classify: mean image {mean.shape[1]}x{mean.shape[2]} "
+                    f"is smaller than the net input {h}x{w}",
+                    file=sys.stderr,
+                )
+                return 1
+        else:
+            mean = np.asarray(
+                [float(v) for v in args.mean.split(",")], np.float32
+            ).reshape(-1, 1, 1)
+    labels = None
+    if args.labels:
+        with open(args.labels) as f:
+            labels = [l.strip() for l in f if l.strip()]
+
+    fwd = jax.jit(net.forward)
+    for path in args.images:
+        img = Image.open(path).convert("L" if c == 1 else "RGB")
+        img = img.resize((w, h), Image.BILINEAR)
+        arr = np.asarray(img, np.float32)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        chw = arr.transpose(2, 0, 1)
+        if mean is not None:
+            # a larger mean image center-crops to the input (the
+            # reference resizes; crop keeps exact mean semantics for
+            # the standard 256-mean/227-input case); (C,1,1) value
+            # means broadcast as-is
+            if mean.shape[1] > h or mean.shape[2] > w:
+                off_h = (mean.shape[1] - h) // 2
+                off_w = (mean.shape[2] - w) // 2
+                mean = mean[:, off_h:off_h + h, off_w:off_w + w]
+            chw = chw - mean
+        batch = {data_blob: chw[None]}
+        blobs = fwd(params, stats, batch)
+        # "prob" if the deploy net names one (the BVLC convention),
+        # else the last layer's top; apply softmax if the scores are
+        # not already a distribution (deploy nets often end at fc)
+        score_blob = (
+            "prob"
+            if "prob" in net.blob_shapes
+            else net.net_param.layer[-1].top[0]
+        )
+        scores = np.asarray(blobs[score_blob])[0].reshape(-1)
+        if scores.min() < 0 or scores.sum() > 1.001:
+            e = np.exp(scores - scores.max())
+            scores = e / e.sum()
+        top = np.argsort(scores)[::-1][: args.topk]
+        print(f"---------- Prediction for {path} ----------")
+        for i in top:
+            name = labels[i] if labels and i < len(labels) else f"class {i}"
+            print(f'{scores[i]:.4f} - "{name}"')
+    return 0
+
+
 def cmd_upgrade_net_proto_text(args) -> int:
     """``upgrade_net_proto_text IN OUT`` — rewrite a legacy (V0/V1)
     net prototxt in the modern format (reference:
@@ -505,6 +604,16 @@ def main(argv=None) -> int:
                    help="write N siamese 2-channel pairs instead")
     p.add_argument("--seed", type=int, default=0)
     p.set_defaults(fn=cmd_convert_mnist)
+
+    p = sub.add_parser("classify")
+    p.add_argument("images", nargs="+")
+    p.add_argument("--model", required=True)
+    p.add_argument("--weights", default=None)
+    p.add_argument("--mean", default=None,
+                   help="mean.binaryproto path or comma-separated values")
+    p.add_argument("--labels", default=None, help="one class name per line")
+    p.add_argument("--topk", type=int, default=5)
+    p.set_defaults(fn=cmd_classify)
 
     for name, fn in (
         ("upgrade_net_proto_text", cmd_upgrade_net_proto_text),

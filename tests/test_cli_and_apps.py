@@ -226,3 +226,64 @@ def test_declared_feed_shapes_per_phase():
     test = cli._declared_feed_shapes(netp, "TEST")
     assert train[0] == (64, 1, 28, 28) and train[1] == (64,)
     assert test[0] == (100, 1, 28, 28) and test[1] == (100,)
+
+
+def test_cli_classify(tmp_path, capsys):
+    """`classify` is the cpp_classification example: deploy net +
+    weights + mean + labels -> top-k predictions."""
+    from PIL import Image
+
+    from sparknet_tpu.io import caffemodel
+    from sparknet_tpu.net import JaxNet
+
+    deploy = tmp_path / "deploy.prototxt"
+    deploy.write_text("""
+name: "tiny"
+layer { name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 1 dim: 3 dim: 8 dim: 8 } } }
+layer { name: "fc" type: "InnerProduct" bottom: "data" top: "fc"
+  inner_product_param { num_output: 3 weight_filler { type: "xavier" } } }
+layer { name: "prob" type: "Softmax" bottom: "fc" top: "prob" }
+""")
+    netp = config.load_net_prototxt(str(deploy))
+    net = JaxNet(netp, phase="TEST")
+    params, stats = net.init(3)
+    # weights biased so class 1 wins on a bright-red image
+    w = np.zeros((3, 3 * 8 * 8), np.float32)
+    w[1, : 8 * 8] = 0.05  # red channel -> class 1
+    params["fc"] = [np.asarray(w), np.zeros(3, np.float32)]
+    weights = tmp_path / "tiny.caffemodel"
+    caffemodel.save_weights(
+        caffemodel.net_blobs(net, params, stats), str(weights)
+    )
+
+    img = np.zeros((8, 8, 3), np.uint8)
+    img[:, :, 0] = 255
+    Image.fromarray(img).save(tmp_path / "red.png")
+    labels = tmp_path / "labels.txt"
+    labels.write_text("zero\nred-thing\ntwo\n")
+
+    rc = cli.main(
+        [
+            "classify",
+            f"--model={deploy}",
+            f"--weights={weights}",
+            f"--labels={labels}",
+            "--mean=10,10,10",
+            "--topk=2",
+            str(tmp_path / "red.png"),
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Prediction for" in out
+    first = [l for l in out.splitlines() if '- "' in l][0]
+    assert '"red-thing"' in first  # the biased class ranks first
+
+
+def test_cli_classify_rejects_label_nets(tmp_path, toy_model, capsys):
+    rc = cli.main(
+        ["classify", f"--model={toy_model}", str(tmp_path / "x.png")]
+    )
+    assert rc == 1
+    assert "deploy config" in capsys.readouterr().err

@@ -152,3 +152,30 @@ def test_negative_varint_roundtrip():
     back = protobin.decode("InnerProductParameter", data)
     assert back.axis == -1 and back.num_output == 7
     del tp
+
+
+def test_extension_fields_roundtrip():
+    """Schema extensions beyond the vendored-era proto (Input/ELU/
+    Scale/Bias params, conv dilation, ip transpose) survive the binary
+    round trip at their public upstream numbers."""
+    NET = """
+    name: "ext"
+    layer { name: "in" type: "Input" top: "x"
+      input_param { shape { dim: 1 dim: 3 dim: 9 dim: 9 } } }
+    layer { name: "c" type: "Convolution" bottom: "x" top: "c"
+      convolution_param { num_output: 2 kernel_size: 3 dilation: 2
+        weight_filler { type: "xavier" } } }
+    layer { name: "e" type: "ELU" bottom: "c" top: "e"
+      elu_param { alpha: 0.75 } }
+    layer { name: "s" type: "Scale" bottom: "e" top: "s"
+      scale_param { bias_term: true } }
+    """
+    netp = config.parse_net_prototxt(NET)
+    back = protobin.decode(
+        "NetParameter", protobin.encode(netp, "NetParameter")
+    )
+    assert prototext.dumps(back) == prototext.dumps(netp)
+    assert back.layer[1].convolution_param.dilation == [2]
+    assert back.layer[2].elu_param.alpha == 0.75
+    assert back.layer[3].scale_param.bias_term is True
+    assert back.layer[0].input_param.shape[0].dim == [1, 3, 9, 9]
