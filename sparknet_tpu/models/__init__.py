@@ -78,6 +78,115 @@ def load_model(name: str, **builder_kwargs) -> NetParameter:
     return load_net_prototxt(path)
 
 
+def deploy_variant(netp: NetParameter, batch: int = 1) -> NetParameter:
+    """Train/test config -> deploy config (the transform behind every
+    BVLC zoo ``deploy.prototxt``): data layers become a single-top
+    ``Input`` at ``batch``, Accuracy/Silence and non-softmax losses
+    drop, and ``SoftmaxWithLoss`` becomes a ``Softmax`` scoring layer
+    named/topped ``prob`` (the convention ``cli classify`` looks for).
+    TEST-phase view is taken first so train-only layers never leak."""
+    from sparknet_tpu.config.schema import (
+        BlobShape,
+        InputParameter,
+        LayerParameter,
+        NetState,
+    )
+    from sparknet_tpu.graph import filter_net
+    from sparknet_tpu.ops.data_layers import _HostFed
+    from sparknet_tpu.ops.base import LAYER_REGISTRY, create_layer
+
+    netp = filter_net(netp, NetState(phase="TEST"))
+    out: list = []
+    label_blobs: set = set()
+    data_done = False
+    for lp in netp.layer:
+        cls = LAYER_REGISTRY.get(lp.type)
+        is_data = cls is not None and issubclass(cls, _HostFed)
+        if is_data or lp.type in ("Data", "DummyData"):
+            if data_done:
+                continue
+            data_done = True
+            tops = list(lp.top)
+            label_blobs.update(tops[1:])  # labels never feed deploy nets
+            try:
+                shapes = create_layer(lp, "TEST").declared_shapes()
+            except Exception:
+                shapes = None
+            if not shapes:
+                raise ValueError(
+                    f"deploy_variant: data layer {lp.name!r} declares no "
+                    "shapes to derive the Input dims from"
+                )
+            dims = [batch] + [int(d) for d in shapes[0][1:]]
+            out.append(
+                LayerParameter(
+                    name="data",
+                    type="Input",
+                    top=[tops[0]],
+                    input_param=InputParameter(
+                        shape=[BlobShape(dim=dims)]
+                    ),
+                )
+            )
+            continue
+        if lp.type in ("Accuracy", "Silence"):
+            continue
+        if cls is not None and getattr(cls, "IS_LOSS", False):
+            # kept for now; the LAST SoftmaxWithLoss becomes the prob
+            # head below, every other loss (aux heads included) drops
+            # and its dead branch is pruned
+            out.append(lp.copy())
+            continue
+        if any(b in label_blobs for b in lp.bottom):
+            continue  # consumers of the label (e.g. reshape helpers)
+        out.append(lp.copy())
+
+    # convert the final SoftmaxWithLoss (the main head, by the zoo
+    # convention of listing aux heads first) and drop the other losses
+    last_swl = max(
+        (i for i, l in enumerate(out) if l.type == "SoftmaxWithLoss"),
+        default=None,
+    )
+    pruned = []
+    for i, lp in enumerate(out):
+        if i == last_swl:
+            lp.type = "Softmax"
+            lp.name = "prob"
+            lp.bottom = [b for b in lp.bottom if b not in label_blobs][:1]
+            lp.top = ["prob"]
+            lp.loss_weight = []
+            pruned.append(lp)
+        elif LAYER_REGISTRY.get(lp.type) is not None and getattr(
+            LAYER_REGISTRY[lp.type], "IS_LOSS", False
+        ):
+            continue
+        else:
+            pruned.append(lp)
+    out = pruned
+
+    # dead-branch elimination: keep only layers reachable from the real
+    # output.  When a prob head was converted, IT is the sole output —
+    # seeding from every unconsumed top would keep the aux-head towers
+    # (their classifier tops are unconsumed too).  Headless nets (e.g.
+    # an R-CNN-style feature model) keep all terminal tops.
+    if last_swl is not None:
+        live = {"prob"}
+    else:
+        consumed = set()
+        for lp in out:
+            consumed.update(lp.bottom)
+        live = {t for lp in out for t in lp.top if t not in consumed}
+    keep = []
+    for lp in reversed(out):
+        if lp.type == "Input" or any(t in live for t in lp.top):
+            keep.append(lp)
+            live.update(lp.bottom)
+    out = list(reversed(keep))
+    import dataclasses as _dc
+
+    return _dc.replace(netp, layer=out)
+
+
 def load_model_solver(name: str) -> SolverParameter:
     path = os.path.join(ZOO_DIR, _SOLVER_FILES[name])
     if not os.path.exists(path):

@@ -360,12 +360,16 @@ def cmd_classify(args) -> int:
     )
     net = JaxNet(netp, phase="TEST")
     if len(net.feed_blobs) > 1:
-        print(
-            "classify: the net wants labels — pass a deploy config "
-            f"(feeds: {net.feed_blobs})",
-            file=sys.stderr,
-        )
-        return 1
+        # train/test config: derive the deploy view (Input data, losses
+        # -> prob) like the BVLC deploy.prototxts do
+        try:
+            netp = models.deploy_variant(netp)
+        except ValueError as e:
+            print(f"classify: {e}", file=sys.stderr)
+            return 1
+        net = JaxNet(netp, phase="TEST")
+        print("classify: derived deploy view from train/test config",
+              file=sys.stderr)
     data_blob = net.feed_blobs[0]
     _, c, h, w = net.blob_shapes[data_blob]
     params, stats = net.init(0)

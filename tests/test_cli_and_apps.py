@@ -281,9 +281,15 @@ layer { name: "prob" type: "Softmax" bottom: "fc" top: "prob" }
     assert '"red-thing"' in first  # the biased class ranks first
 
 
-def test_cli_classify_rejects_label_nets(tmp_path, toy_model, capsys):
+def test_cli_classify_derives_deploy_view(tmp_path, toy_model, capsys):
+    """A train/test config classifies anyway: the deploy view (Input +
+    prob) is derived on the fly, like the BVLC deploy.prototxts."""
+    from PIL import Image
+
+    img = np.zeros((8, 8, 3), np.uint8)
+    Image.fromarray(img).save(tmp_path / "x.png")
     rc = cli.main(
         ["classify", f"--model={toy_model}", str(tmp_path / "x.png")]
     )
-    assert rc == 1
-    assert "deploy config" in capsys.readouterr().err
+    assert rc == 0
+    assert "derived deploy view" in capsys.readouterr().err

@@ -137,3 +137,52 @@ def test_model_solvers_load():
         sp = models.load_model_solver(name)
         assert sp.net_param is not None
         assert sp.base_lr > 0
+
+
+def test_deploy_variant():
+    """Train/test -> deploy transform (the BVLC deploy.prototxt role):
+    Input data layer, losses/accuracy dropped, SoftmaxWithLoss -> prob."""
+    netp = models.load_model("lenet")
+    dep = models.deploy_variant(netp, batch=4)
+    types = [l.type for l in dep.layer]
+    assert types[0] == "Input"
+    assert "SoftmaxWithLoss" not in types and "Accuracy" not in types
+    assert types[-1] == "Softmax"
+    assert dep.layer[-1].top == ["prob"]
+    assert dep.layer[0].input_param.shape[0].dim == [4, 1, 28, 28]
+
+    net = JaxNet(dep, phase="TEST")
+    assert net.feed_blobs == ["data"]
+    params, stats = net.init(0)
+    x = np.random.RandomState(0).rand(4, 1, 28, 28).astype(np.float32)
+    blobs = net.forward(params, stats, {"data": x})
+    probs = np.asarray(blobs["prob"])
+    assert probs.shape == (4, 10)
+    np.testing.assert_allclose(probs.sum(1), 1.0, rtol=1e-5)
+
+    # deep model with aux heads: all three loss branches disappear
+    goog = models.deploy_variant(
+        models.load_model("googlenet", batch=2, image=64, classes=5)
+    )
+    gtypes = [l.type for l in goog.layer]
+    assert "SoftmaxWithLoss" not in gtypes
+    gnet = JaxNet(goog, phase="TEST")
+    assert gnet.feed_blobs == ["data"]
+    assert "prob" in gnet.blob_shapes
+
+
+def test_deploy_variant_prunes_aux_towers():
+    """GoogLeNet's aux-head towers (loss1/*, loss2/*) vanish from the
+    deploy view — only the main-head path survives, like the reference
+    bvlc_googlenet deploy.prototxt."""
+    goog = models.deploy_variant(
+        models.load_model("googlenet", batch=2, image=64, classes=5)
+    )
+    names = [l.name for l in goog.layer]
+    assert not any(n.startswith(("loss1/", "loss2/")) for n in names)
+    assert names[-1] == "prob"
+    net = JaxNet(goog, phase="TEST")
+    # exactly one terminal output: prob
+    consumed = {b for l in goog.layer for b in l.bottom}
+    terminals = {t for l in goog.layer for t in l.top} - consumed
+    assert terminals == {"prob"}
