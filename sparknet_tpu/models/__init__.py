@@ -102,14 +102,20 @@ def deploy_variant(netp: NetParameter, batch: int = 1) -> NetParameter:
     for lp in netp.layer:
         cls = LAYER_REGISTRY.get(lp.type)
         is_data = cls is not None and issubclass(cls, _HostFed)
-        if is_data or lp.type in ("Data", "DummyData"):
+        if is_data or lp.type == "DummyData":
             if data_done:
                 continue
             data_done = True
             tops = list(lp.top)
             label_blobs.update(tops[1:])  # labels never feed deploy nets
+            shapes = None
             try:
-                shapes = create_layer(lp, "TEST").declared_shapes()
+                layer = create_layer(lp, "TEST")
+                if hasattr(layer, "declared_shapes"):
+                    shapes = layer.declared_shapes()
+                if not shapes:
+                    # DummyData declares dims via out_shapes
+                    shapes = layer.out_shapes([])
             except Exception:
                 shapes = None
             if not shapes:

@@ -819,3 +819,40 @@ class Filter(Layer):
             mask = sel.reshape((-1,) + (1,) * (b.ndim - 1))
             outs.append(b * (mask > 0))
         return outs, None
+
+
+@register
+class Python(Layer):
+    """User-defined layers (reference: ``python_layer.hpp`` +
+    ``PythonParameter``): ``python_param.module``/``layer`` name a class
+    implementing this framework's Layer contract
+    (``blob_defs``/``out_shapes``/``apply``); ``param_str`` reaches the
+    class through ``self.lp.python_param.param_str``.  Construction
+    dispatches straight to the user class — its IS_LOSS / precision
+    flags and loss weights apply natively."""
+
+    TYPE = "Python"
+
+    def __new__(cls, lp, phase):
+        import importlib
+
+        p = lp.python_param
+        if not (p and p.module and p.layer):
+            raise ValueError(
+                f"layer {lp.name!r}: Python layers need python_param "
+                "{ module: ... layer: ... }"
+            )
+        try:
+            mod = importlib.import_module(p.module)
+        except ImportError as e:
+            raise ValueError(
+                f"layer {lp.name!r}: cannot import python_param module "
+                f"{p.module!r}: {e}"
+            ) from e
+        ucls = getattr(mod, p.layer, None)
+        if not (isinstance(ucls, type) and issubclass(ucls, Layer)):
+            raise TypeError(
+                f"layer {lp.name!r}: {p.module}.{p.layer} must be a "
+                "sparknet_tpu.ops.base.Layer subclass"
+            )
+        return ucls(lp, phase)

@@ -440,6 +440,24 @@ def cmd_classify(args) -> int:
     return 0
 
 
+def cmd_parse_log(args) -> int:
+    """``parse_log LOG [--out PREFIX]`` — training log -> train/test
+    CSVs (the ``tools/extra/parse_log.py`` role, for this framework's
+    ``training_log_<ts>.txt`` format)."""
+    from sparknet_tpu.tools import parse_log as pl
+
+    train, test = pl.parse_log(args.log)
+    import os
+
+    prefix = args.out or os.path.splitext(args.log)[0]
+    paths = pl.write_csvs(train, test, prefix)
+    print(
+        f"parsed {len(train)} train rows, {len(test)} test rows -> "
+        + ", ".join(paths)
+    )
+    return 0
+
+
 def cmd_upgrade_net_proto_text(args) -> int:
     """``upgrade_net_proto_text IN OUT`` — rewrite a legacy (V0/V1)
     net prototxt in the modern format (reference:
@@ -608,6 +626,11 @@ def main(argv=None) -> int:
                    help="write N siamese 2-channel pairs instead")
     p.add_argument("--seed", type=int, default=0)
     p.set_defaults(fn=cmd_convert_mnist)
+
+    p = sub.add_parser("parse_log")
+    p.add_argument("log")
+    p.add_argument("--out", default=None, help="CSV prefix")
+    p.set_defaults(fn=cmd_parse_log)
 
     p = sub.add_parser("classify")
     p.add_argument("images", nargs="+")

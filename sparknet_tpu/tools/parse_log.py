@@ -1,0 +1,105 @@
+"""Training-log parser — the ``tools/extra/parse_log.py`` role.
+
+Parses this framework's ``training_log_<ts>*.txt`` format (elapsed
+seconds + structured phase messages, ``utils/trainlog.py``) into
+train/test row tables and CSV files, so training curves plot without
+ad-hoc grepping — the same workflow the reference's parse_log.py +
+plot_training_log.py serve for glog output.
+
+Recognized lines:
+
+- ``<sec>: round <r> trained, smoothed_loss <v>``   (app loops)
+- ``<sec>: iter <i> smoothed_loss <v>``             (cli train)
+- ``<sec>: test output <name> = <v>``               (test phases)
+- ``<sec>, i = <r>: <message ...>``                 (round-indexed)
+"""
+
+from __future__ import annotations
+
+import csv
+import re
+from typing import Dict, List, Tuple
+
+_TRAIN_ROUND = re.compile(
+    r"^([\d.]+):\s+round\s+(\d+)\s+trained,\s+smoothed_loss\s+([-\d.eE]+)"
+)
+_TRAIN_ITER = re.compile(
+    r"^([\d.]+):\s+iter\s+(\d+)\s+smoothed_loss\s+([-\d.eE]+)"
+)
+_TEST_OUT = re.compile(
+    r"^([\d.]+):\s+test output\s+(\S+)\s+=\s+([-\d.eE]+)"
+)
+_ROUND_SCORE = re.compile(
+    r"^([\d.]+):\s+round\s+(\d+),\s+(\w+)\s+([-\d.eE]+)"
+)
+
+
+def parse_log(path: str) -> Tuple[List[dict], List[dict]]:
+    """-> (train_rows, test_rows).
+
+    train rows: {seconds, round_or_iter, smoothed_loss};
+    test rows: {seconds, <output name>: value, ...} — consecutive
+    ``test output`` lines at one timestamp merge into one row."""
+    train: List[dict] = []
+    test: List[dict] = []
+    pending: Dict[str, float] = {}
+    pending_sec = None
+
+    def flush():
+        nonlocal pending, pending_sec
+        if pending:
+            test.append({"seconds": pending_sec, **pending})
+        pending, pending_sec = {}, None
+
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            m = _TEST_OUT.match(line)
+            if m:
+                sec = float(m.group(1))
+                if pending_sec is not None and sec != pending_sec:
+                    flush()
+                pending_sec = sec
+                pending[m.group(2)] = float(m.group(3))
+                continue
+            m = _TRAIN_ROUND.match(line) or _TRAIN_ITER.match(line)
+            if m:
+                flush()
+                train.append(
+                    {
+                        "seconds": float(m.group(1)),
+                        "round_or_iter": int(m.group(2)),
+                        "smoothed_loss": float(m.group(3)),
+                    }
+                )
+                continue
+            m = _ROUND_SCORE.match(line)
+            if m:
+                # "round R, accuracy A" annotates the pending test row
+                if pending_sec is None:
+                    pending_sec = float(m.group(1))
+                pending.setdefault("round", int(m.group(2)))
+                pending[m.group(3)] = float(m.group(4))
+                continue
+            flush()
+    flush()
+    return train, test
+
+
+def write_csvs(train: List[dict], test: List[dict], prefix: str) -> List[str]:
+    paths = []
+    for rows, kind in ((train, "train"), (test, "test")):
+        if not rows:
+            continue
+        path = f"{prefix}.{kind}.csv"
+        keys: List[str] = []
+        for row in rows:
+            for k in row:
+                if k not in keys:
+                    keys.append(k)
+        with open(path, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=keys)
+            w.writeheader()
+            w.writerows(rows)
+        paths.append(path)
+    return paths

@@ -293,3 +293,45 @@ def test_cli_classify_derives_deploy_view(tmp_path, toy_model, capsys):
     )
     assert rc == 0
     assert "derived deploy view" in capsys.readouterr().err
+
+
+def test_cli_parse_log(tmp_path, capsys):
+    """parse_log turns a training log into train/test CSVs (the
+    tools/extra/parse_log.py role)."""
+    import csv as _csv
+
+    log = tmp_path / "training_log_1_x.txt"
+    log.write_text(
+        "0.100: loaded data\n"
+        "1.000: test output accuracy = 0.1000\n"
+        "1.000: test output loss = 2.3026\n"
+        "1.000: round 0, accuracy 0.1000\n"
+        "2.000: round 0 trained, smoothed_loss 2.1000\n"
+        "3.000: round 1 trained, smoothed_loss 1.9000\n"
+        "4.000: test output accuracy = 0.5500\n"
+        "4.000: round 2, accuracy 0.5500\n"
+        "5.000: iter 30 smoothed_loss 1.5000\n"
+    )
+    rc = cli.main(["parse_log", str(log), f"--out={tmp_path}/curve"])
+    assert rc == 0
+    with open(tmp_path / "curve.train.csv") as f:
+        rows = list(_csv.DictReader(f))
+    assert len(rows) == 3
+    assert rows[0]["smoothed_loss"] == "2.1"
+    assert rows[2]["round_or_iter"] == "30"
+    with open(tmp_path / "curve.test.csv") as f:
+        trows = list(_csv.DictReader(f))
+    assert len(trows) == 2
+    assert trows[0]["accuracy"] == "0.1" and trows[0]["loss"] == "2.3026"
+    assert trows[1]["accuracy"] == "0.55"
+
+    # the real committed artifact parses too
+    artifact = os.path.join(
+        os.path.dirname(__file__),
+        "..",
+        "training_log_1785415499109_cifar_quick.txt",
+    )
+    train, test = __import__(
+        "sparknet_tpu.tools.parse_log", fromlist=["parse_log"]
+    ).parse_log(artifact)
+    assert len(train) == 80 and len(test) >= 8

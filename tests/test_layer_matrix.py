@@ -180,6 +180,12 @@ SPECS = {
         proto='type: "PReLU"', mode="grad",
         bottoms=lambda: [_away_from_zero(R.randn(2, 3, 4, 4))],
     ),
+    "Python": dict(
+        proto='type: "Python" python_param '
+              '{ module: "tests.test_layers" layer: "ScaledIdentity" '
+              'param_str: "1.5" }',
+        mode="grad", bottoms=lambda: [R.randn(3, 4)],
+    ),
     "Pooling": dict(
         proto='type: "Pooling" pooling_param '
               "{ pool: MAX kernel_size: 3 stride: 2 }",

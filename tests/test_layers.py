@@ -478,3 +478,72 @@ def test_analytic_flops_alexnet():
     fwd = flops.forward_flops(net)
     assert 1.3e9 < fwd < 1.6e9, fwd
     assert flops.train_flops(net) == 3.0 * fwd
+
+
+class DoubleIt:  # not a Layer subclass: must be rejected
+    pass
+
+
+from sparknet_tpu.ops.base import Layer as _Layer  # noqa: E402
+
+
+class ScaledIdentity(_Layer):
+    """Test fixture for the Python custom-layer dispatch."""
+
+    TYPE = "ScaledIdentity"
+
+    def out_shapes(self, bottom_shapes):
+        return [bottom_shapes[0]]
+
+    def apply(self, blobs, bottoms, rng, train):
+        scale = float(self.lp.python_param.param_str or "1")
+        return [bottoms[0] * scale], None
+
+
+def test_python_layer_dispatch():
+    """type: "Python" resolves python_param.module/layer to a user Layer
+    subclass (python_layer.hpp role); param_str reaches the class."""
+    l = _layer(
+        'name: "py" type: "Python" python_param '
+        '{ module: "tests.test_layers" layer: "ScaledIdentity" '
+        'param_str: "2.5" }'
+    )
+    # pytest imports this file as top-level `test_layers`, while the
+    # dispatch imports `tests.test_layers` — same class, two module
+    # objects, so compare by identity of behavior/name not isinstance
+    assert type(l).__name__ == "ScaledIdentity"
+    (out,), _ = l.apply([], [jnp.asarray([1.0, 2.0])], None, True)
+    np.testing.assert_allclose(np.asarray(out), [2.5, 5.0])
+
+    with pytest.raises(TypeError, match="Layer subclass"):
+        _layer(
+            'name: "py" type: "Python" python_param '
+            '{ module: "tests.test_layers" layer: "DoubleIt" }'
+        )
+    with pytest.raises(ValueError, match="cannot import"):
+        _layer(
+            'name: "py" type: "Python" python_param '
+            '{ module: "no.such.module" layer: "X" }'
+        )
+    with pytest.raises(ValueError, match="need python_param"):
+        _layer('name: "py" type: "Python"')
+
+
+def test_python_layer_in_net():
+    from sparknet_tpu import config as _config
+    from sparknet_tpu.net import JaxNet as _JaxNet
+
+    NET = """
+    layer { name: "d" type: "HostData" top: "x"
+      java_data_param { shape { dim: 2 dim: 3 } } }
+    layer { name: "py" type: "Python" bottom: "x" top: "y"
+      python_param { module: "tests.test_layers" layer: "ScaledIdentity"
+        param_str: "3" } }
+    layer { name: "red" type: "Reduction" bottom: "y" top: "loss"
+      loss_weight: 1.0 reduction_param { operation: MEAN axis: 0 } }
+    """
+    net = _JaxNet(_config.parse_net_prototxt(NET), phase="TRAIN")
+    params, stats = net.init(0)
+    x = np.ones((2, 3), np.float32)
+    out = net.apply(params, stats, {"x": x}, rng=jax.random.PRNGKey(0))
+    np.testing.assert_allclose(np.asarray(out.blobs["y"]), 3.0 * x)

@@ -186,3 +186,20 @@ def test_deploy_variant_prunes_aux_towers():
     consumed = {b for l in goog.layer for b in l.bottom}
     terminals = {t for l in goog.layer for t in l.top} - consumed
     assert terminals == {"prob"}
+
+
+def test_deploy_variant_dummy_data():
+    """DummyData data layers (dims via out_shapes) convert too."""
+    from sparknet_tpu import config
+
+    NET = """
+    layer { name: "d" type: "DummyData" top: "data" top: "label"
+      dummy_data_param { shape { dim: 4 dim: 3 dim: 8 dim: 8 } shape { dim: 4 } } }
+    layer { name: "ip" type: "InnerProduct" bottom: "data" top: "logits"
+      inner_product_param { num_output: 2 weight_filler { type: "xavier" } } }
+    layer { name: "loss" type: "SoftmaxWithLoss" bottom: "logits" bottom: "label" top: "loss" }
+    """
+    dep = models.deploy_variant(config.parse_net_prototxt(NET), batch=2)
+    assert dep.layer[0].type == "Input"
+    assert dep.layer[0].input_param.shape[0].dim == [2, 3, 8, 8]
+    assert dep.layer[-1].top == ["prob"]
