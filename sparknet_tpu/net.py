@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from sparknet_tpu.config.schema import NetParameter, NetState
 from sparknet_tpu.graph import filter_net, toposort_check
 from sparknet_tpu.ops import fillers  # noqa: F401  (registry population)
-from sparknet_tpu.ops import common, data_layers, losses, vision  # noqa: F401
+from sparknet_tpu.ops import attention, common, data_layers, losses, vision  # noqa: F401
 from sparknet_tpu.ops.base import BlobDef, Layer, create_layer
 
 Params = Dict[str, List[jax.Array]]

@@ -1,5 +1,5 @@
 """Layer zoo + fillers. Importing this package populates the registry."""
 
 from sparknet_tpu.ops import base, fillers  # noqa: F401
-from sparknet_tpu.ops import common, data_layers, losses, vision  # noqa: F401
+from sparknet_tpu.ops import attention, common, data_layers, losses, vision  # noqa: F401
 from sparknet_tpu.ops.base import LAYER_REGISTRY, Layer, create_layer, register  # noqa: F401
