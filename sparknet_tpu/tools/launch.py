@@ -149,8 +149,19 @@ def spawn_local(args, app_argv) -> int:
 
 
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    # cluster lifecycle actions (spark_ec2.py real_main action dispatch
+    # analog) live in tools/provision.py: `launch provision --dry-run ...`
+    from sparknet_tpu.tools import provision
+
+    if argv and argv[0] in provision.ACTIONS:
+        return provision.main(argv[0], argv[1:])
+
     parser = argparse.ArgumentParser(
-        prog="launch", description=__doc__.split("\n", 1)[0]
+        prog="launch", description=__doc__.split("\n", 1)[0],
+        epilog="cluster lifecycle actions (dispatched before app "
+        "launch): launch provision|describe|run|ssh|teardown "
+        "[--dry-run] ... — see `launch provision --help` and SETUP.md §1",
     )
     parser.add_argument(
         "--nprocs", type=int, default=0,
@@ -167,7 +178,11 @@ def main(argv=None) -> int:
     parser.add_argument("--num_processes", type=int, default=None)
     parser.add_argument("--process_id", type=int, default=None)
     parser.add_argument("--timeout", type=int, default=1200)
-    parser.add_argument("app", choices=sorted(APPS))
+    # lifecycle actions appear in choices purely for help/typo messages;
+    # real action argv is dispatched above before argparse runs
+    parser.add_argument(
+        "app", choices=sorted(APPS) + list(provision.ACTIONS)
+    )
     parser.add_argument("app_argv", nargs=argparse.REMAINDER,
                         help="arguments passed through to the app")
     args = parser.parse_args(argv)

@@ -1,0 +1,197 @@
+"""Cluster provisioning — the create/describe/destroy half of L8.
+
+Reference: ``ec2/spark_ec2.py`` (1,528 LoC; ``launch_cluster`` at ``:481``,
+action dispatch in ``real_main`` at ``:1256-1518``) provisions EC2
+instances, waits for SSH, deploys files, and tears clusters down.  The
+TPU-native analog provisions a Cloud TPU pod slice (every host of the slice
+is one worker VM) through ``gcloud compute tpus tpu-vm``:
+
+    provision  ->  create slice, wait READY, deploy the repo to every
+                   worker, install nothing (jax ships on the TPU image)
+    describe   ->  slice state + worker endpoints      (get_existing_cluster)
+    run        ->  submit an app on every worker       (spark-submit analog)
+    ssh        ->  interactive shell on one worker     (login action)
+    teardown   ->  delete the slice                    (destroy action)
+
+Every action resolves to an exact ``gcloud`` command sequence from
+``command_plan`` — a pure function so tests (and ``--dry-run``) can assert
+the sequence without a cloud project.  ``--dry-run`` prints one
+shell-quoted command per line and executes nothing, making SETUP.md's
+walkthrough an executable artifact::
+
+    python -m sparknet_tpu.tools.launch provision --dry-run \
+        --name=sparknet-v5e --zone=us-west4-8a --accelerator=v5litepod-8
+
+Unlike ``spark_ec2.py`` there are no security groups, AMI resolution, or
+SSH-readiness polling loops to hand-roll: the TPU runtime image carries the
+ML stack, ``gcloud ... ssh`` brokers IAP/keys, and slice state is a single
+``describe`` field — so the whole layer stays small without losing the
+reference's capability.
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import subprocess
+import sys
+from typing import List, Optional
+
+ACTIONS = ("provision", "describe", "run", "ssh", "teardown")
+
+# Default runtime image for current-generation slices; override with
+# --version (the analog of spark_ec2's --spark-version/AMI resolution).
+DEFAULT_VERSION = "tpu-ubuntu2204-base"
+DEFAULT_REMOTE_DIR = "~/sparknet_tpu"
+
+
+def _gcloud_tpu(opts) -> List[str]:
+    cmd = ["gcloud"]
+    if opts.project:
+        cmd += ["--project", opts.project]
+    cmd += ["compute", "tpus", "tpu-vm"]
+    return cmd
+
+
+def command_plan(
+    action: str, opts, app_argv: Optional[List[str]] = None
+) -> List[List[str]]:
+    """The exact gcloud command sequence for one action (pure; no I/O)."""
+    base = _gcloud_tpu(opts)
+    zone = ["--zone", opts.zone]
+    if action == "provision":
+        create = base + [
+            "create", opts.name, *zone,
+            "--accelerator-type", opts.accelerator,
+            "--version", opts.version,
+        ]
+        if opts.spot:
+            create += ["--spot"]
+        if opts.network:
+            create += ["--network", opts.network]
+        plan = [create]
+        # wait-for-READY: gcloud create blocks until the slice exists, but
+        # state is re-checked explicitly the way spark_ec2 waits for
+        # 'ssh-ready' (spark_ec2.py:905) — one describe, judged by caller
+        plan.append(
+            base + ["describe", opts.name, *zone, "--format=value(state)"]
+        )
+        # deploy the framework to every worker (deploy_files analog,
+        # spark_ec2.py:1035).  scp -r into an EXISTING dir would nest the
+        # copy one level down (stale code on redeploy), so clear first —
+        # the role rsync played in spark_ec2's deploy
+        plan.append(
+            base + [
+                "ssh", opts.name, *zone, "--worker=all",
+                "--command", f"rm -rf {opts.remote_dir}",
+            ]
+        )
+        plan.append(
+            base + [
+                "scp", "--recurse", opts.repo,
+                f"{opts.name}:{opts.remote_dir}",
+                *zone, "--worker=all",
+            ]
+        )
+        return plan
+    if action == "describe":
+        return [
+            base + ["describe", opts.name, *zone],
+        ]
+    if action == "run":
+        # spark-submit analog: the same launch line on every worker;
+        # jax.distributed discovers slice topology from metadata, so no
+        # coordinator flags are needed (tools/launch.py docstring)
+        app_line = " ".join(
+            ["cd", opts.remote_dir, "&&", "python", "-m",
+             "sparknet_tpu.tools.launch"]
+            + [shlex.quote(a) for a in (app_argv or [])]
+        )
+        return [
+            base + [
+                "ssh", opts.name, *zone, "--worker=all",
+                "--command", app_line,
+            ]
+        ]
+    if action == "ssh":
+        return [
+            base + ["ssh", opts.name, *zone, f"--worker={opts.worker}"],
+        ]
+    if action == "teardown":
+        return [
+            base + ["delete", opts.name, *zone, "--quiet"],
+        ]
+    raise ValueError(f"unknown action {action!r}")
+
+
+def format_plan(plan: List[List[str]]) -> str:
+    return "\n".join(shlex.join(cmd) for cmd in plan)
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="launch provision|describe|run|ssh|teardown",
+        description=__doc__.split("\n", 1)[0],
+    )
+    p.add_argument("--name", default="sparknet", help="slice name")
+    p.add_argument("--zone", default="us-central2-b")
+    p.add_argument("--project", default=None)
+    p.add_argument(
+        "--accelerator", default="v5litepod-8",
+        help="accelerator type, e.g. v5litepod-8 / v4-32",
+    )
+    p.add_argument("--version", default=DEFAULT_VERSION,
+                   help="TPU runtime image")
+    p.add_argument("--spot", action="store_true",
+                   help="preemptible capacity (spark_ec2 --spot-price analog)")
+    p.add_argument("--network", default=None)
+    p.add_argument("--repo", default=".", help="local repo dir to deploy")
+    p.add_argument("--remote_dir", default=DEFAULT_REMOTE_DIR)
+    p.add_argument("--worker", default="0", help="worker index for ssh")
+    p.add_argument("--dry-run", dest="dry_run", action="store_true",
+                   help="print the exact command sequence; execute nothing")
+    return p
+
+
+def main(action: str, argv: List[str]) -> int:
+    # `run` forwards everything after `--` to the app launch line
+    argv = list(argv)
+    app_argv: List[str] = []
+    if "--" in argv:
+        cut = argv.index("--")
+        argv, app_argv = argv[:cut], argv[cut + 1:]
+    opts = make_parser().parse_args(argv)
+    plan = command_plan(action, opts, app_argv)
+    if opts.dry_run:
+        print(format_plan(plan))
+        return 0
+    for cmd in plan:
+        print("+ " + shlex.join(cmd), file=sys.stderr)
+        if "--format=value(state)" in cmd:
+            # the wait-for-READY step: judge the state, poll until READY
+            # (spark_ec2.py wait_for_cluster_state analog, :905)
+            rc = _wait_ready(cmd)
+        else:
+            rc = subprocess.call(cmd)
+        if rc != 0:
+            return rc
+    return 0
+
+
+def _wait_ready(cmd, tries: int = 90, sleep_s: int = 10) -> int:
+    import time
+
+    for i in range(tries):
+        r = subprocess.run(cmd, capture_output=True, text=True)
+        state = r.stdout.strip()
+        if r.returncode == 0 and state == "READY":
+            print("slice state: READY", file=sys.stderr)
+            return 0
+        print(
+            f"slice state: {state or r.stderr.strip()!r} "
+            f"(waiting, {i + 1}/{tries})",
+            file=sys.stderr,
+        )
+        time.sleep(sleep_s)
+    print("timed out waiting for READY", file=sys.stderr)
+    return 1

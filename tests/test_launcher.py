@@ -64,3 +64,75 @@ def test_launcher_two_host_cifar(tmp_path):
     # per-host training logs were written into the cwd
     logs = [f for f in os.listdir(tmp_path) if f.startswith("training_log_")]
     assert len(logs) >= 1, logs
+
+
+# ---------------------------------------------------------------------------
+# Provisioning actions (spark_ec2.py launch/destroy/login analog): the plan
+# is a pure function, so the exact gcloud sequence is asserted without a
+# cloud project, and --dry-run must emit exactly that sequence.
+
+
+def _run_launch(args):
+    out = subprocess.run(
+        [sys.executable, "-m", "sparknet_tpu.tools.launch", *args],
+        env={**os.environ, "PYTHONPATH": _REPO, "JAX_PLATFORMS": "cpu",
+             "PALLAS_AXON_POOL_IPS": ""},
+        capture_output=True, text=True, timeout=120,
+    )
+    return out
+
+
+def test_provision_dry_run_emits_exact_sequence():
+    out = _run_launch([
+        "provision", "--dry-run", "--name=sparknet-v5e",
+        "--zone=us-west4-8a", "--accelerator=v5litepod-8",
+        "--repo=/root/repo", "--spot",
+    ])
+    assert out.returncode == 0, out.stderr
+    lines = out.stdout.strip().splitlines()
+    assert lines == [
+        "gcloud compute tpus tpu-vm create sparknet-v5e "
+        "--zone us-west4-8a --accelerator-type v5litepod-8 "
+        "--version tpu-ubuntu2204-base --spot",
+        "gcloud compute tpus tpu-vm describe sparknet-v5e "
+        "--zone us-west4-8a '--format=value(state)'",
+        "gcloud compute tpus tpu-vm ssh sparknet-v5e --zone us-west4-8a "
+        "--worker=all --command 'rm -rf ~/sparknet_tpu'",
+        "gcloud compute tpus tpu-vm scp --recurse /root/repo "
+        "'sparknet-v5e:~/sparknet_tpu' --zone us-west4-8a --worker=all",
+    ]
+
+
+def test_teardown_and_run_dry_run():
+    out = _run_launch([
+        "teardown", "--dry-run", "--name=c1", "--zone=z1",
+    ])
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == (
+        "gcloud compute tpus tpu-vm delete c1 --zone z1 --quiet"
+    )
+
+    out = _run_launch([
+        "run", "--dry-run", "--name=c1", "--zone=z1", "--",
+        "imagenet", "--rounds=100",
+    ])
+    assert out.returncode == 0, out.stderr
+    line = out.stdout.strip()
+    assert line.startswith("gcloud compute tpus tpu-vm ssh c1 --zone z1 "
+                           "--worker=all --command ")
+    assert "python -m sparknet_tpu.tools.launch imagenet --rounds=100" in line
+
+
+def test_provision_plan_pure_function():
+    from sparknet_tpu.tools import provision
+
+    opts = provision.make_parser().parse_args(
+        ["--name=n", "--zone=z", "--project=p"]
+    )
+    plan = provision.command_plan("describe", opts)
+    assert plan == [[
+        "gcloud", "--project", "p", "compute", "tpus", "tpu-vm",
+        "describe", "n", "--zone", "z",
+    ]]
+    ssh = provision.command_plan("ssh", opts)
+    assert ssh[0][-1] == "--worker=0"
