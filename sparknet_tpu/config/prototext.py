@@ -389,7 +389,80 @@ _V0_ROUTES = {
     ("mirror", "data"): ("transform_param", "mirror"),
     ("mirror", "images"): ("transform_param", "mirror"),
     ("mirror", "window_data"): ("transform_param", "mirror"),
+    # R-CNN-era detection fields (upgrade_proto.cpp:382-412)
+    ("det_fg_threshold", "window_data"): ("window_data_param", "fg_threshold"),
+    ("det_bg_threshold", "window_data"): ("window_data_param", "bg_threshold"),
+    ("det_fg_fraction", "window_data"): ("window_data_param", "fg_fraction"),
+    ("det_context_pad", "window_data"): ("window_data_param", "context_pad"),
+    ("det_crop_mode", "window_data"): ("window_data_param", "crop_mode"),
 }
+
+
+def _tok_str(tok: Any) -> str:
+    s = str(tok)
+    return s[4:] if s.startswith("\0STR") else s
+
+
+def _v0_type(entry: Dict[str, List[Any]]) -> str:
+    inner = entry["layer"][0]
+    return _tok_str(inner.get("type", [""])[0])
+
+
+def _fold_v0_padding(d: Dict[str, List[Any]]) -> None:
+    """Merge V0 ``padding`` layers into the following conv/pool layer
+    (reference: ``UpgradeV0PaddingLayers``, upgrade_proto.cpp:120-178):
+    the padding layer disappears, its ``pad`` lands on the consumer, and
+    the consumer's bottom is rewired to the padding layer's input."""
+    entries = d.get("layers") or []
+    if not any(isinstance(e, dict) and "layer" in e for e in entries):
+        return
+    blob_src: Dict[str, Any] = {
+        _tok_str(t): None for t in d.get("input", [])
+    }
+    kept = []
+    for e in entries:
+        is_v0 = isinstance(e, dict) and "layer" in e
+        if not (is_v0 and _v0_type(e) == "padding"):
+            kept.append(e)
+        for j, b in enumerate(e.get("bottom", []) if isinstance(e, dict) else []):
+            bname = _tok_str(b)
+            if bname not in blob_src:
+                # reference LOG(FATAL)s on unknown inputs here
+                # (upgrade_proto.cpp:142-144); a dangling bottom must not
+                # survive the fold silently
+                raise ValueError(
+                    f"V0 net: unknown blob input {bname!r} (no earlier "
+                    "top or net input produces it)"
+                )
+            src = blob_src[bname]
+            if not (isinstance(src, dict) and "layer" in src
+                    and _v0_type(src) == "padding"):
+                continue
+            # the reference declares these geometries undefined and
+            # CHECK-fails (upgrade_proto.cpp:152-163): consumer must be a
+            # single-bottom conv/pool; padding must be 1-bottom/1-top
+            if not (is_v0 and _v0_type(e) in ("conv", "pool")):
+                raise ValueError(
+                    "V0 padding layer feeds a non-conv/pool layer "
+                    f"({_v0_type(e) if is_v0 else 'V1'}) — undefined in "
+                    "the reference upgrade (upgrade_proto.cpp:152-155)"
+                )
+            if len(e.get("bottom", [])) != 1:
+                raise ValueError(
+                    "V0 padding-fed conv/pool layer must take a single "
+                    "bottom (upgrade_proto.cpp:156-157)"
+                )
+            if len(src.get("bottom", [])) != 1 or len(src.get("top", [])) != 1:
+                raise ValueError(
+                    "V0 padding layer must have one bottom and one top "
+                    "(upgrade_proto.cpp:158-163)"
+                )
+            e["layer"][0]["pad"] = list(src["layer"][0].get("pad", ["0"]))
+            e["bottom"][j] = src["bottom"][0]
+        if isinstance(e, dict):
+            for t in e.get("top", []):
+                blob_src[_tok_str(t)] = e
+    d["layers"] = kept
 
 
 def _upgrade_v0_entry(entry: Dict[str, List[Any]]) -> Dict[str, List[Any]]:
@@ -434,9 +507,10 @@ def _upgrade_v0_tokens(d: Dict[str, List[Any]]) -> None:
     entries = d.get("layers")
     if not entries:
         return
+    _fold_v0_padding(d)
     d["layers"] = [
         _upgrade_v0_entry(e) if isinstance(e, dict) and "layer" in e else e
-        for e in entries
+        for e in d.get("layers") or []
     ]
 
 

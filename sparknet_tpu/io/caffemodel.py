@@ -86,8 +86,8 @@ def save_weights(layer_blobs: Blobs, path: str, net_name: str = "net") -> None:
 
 
 def load_weights(path: str) -> Blobs:
-    """Read a .caffemodel (modern layer=100 or V1 layers=2) into
-    {layer_name: [np arrays]}."""
+    """Read a .caffemodel (modern layer=100, V1 layers=2, or V0-era
+    nested layers=2 -> layer=1) into {layer_name: [np arrays]}."""
     with open(path, "rb") as f:
         data = f.read()
     fields = wire.collect_fields(data)
@@ -104,6 +104,14 @@ def load_weights(path: str) -> Blobs:
         blobs = [decode_blob(b) for b in lf.get(6, [])]
         if blobs:
             out[name] = blobs
+        # V0-era connection: weights nest one level deeper
+        # (V1LayerParameter.layer=1 -> V0LayerParameter{name=1 blobs=50})
+        for v0_msg in lf.get(1, []):
+            v0 = wire.collect_fields(v0_msg)
+            v0_name = bytes(v0.get(1, [b""])[-1]).decode("utf-8")
+            v0_blobs = [decode_blob(b) for b in v0.get(50, [])]
+            if v0_blobs:
+                out[v0_name or name] = v0_blobs
     return out
 
 

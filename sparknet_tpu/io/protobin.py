@@ -22,8 +22,11 @@ Codec rules:
   into modern ``LayerParameter`` objects (its enum ``type`` becomes the
   V1 NAME string that ``config.prototext._upgrade_net`` already
   converts; its legacy string ``param`` becomes ``ParamSpec.name``);
-- fields with no schema counterpart (layer ``blobs`` weights, V0 nested
-  ``layer``) raise with guidance rather than silently dropping data.
+- V0-era nets (nested ``layer`` connection messages inside ``layers``)
+  decode to prototext token dicts and run the shared V0 upgrade
+  (``UpgradeV0Net`` analog: padding-layer folding + per-field routing);
+- fields with no schema counterpart (layer ``blobs`` weights) raise with
+  guidance rather than silently dropping data.
 """
 
 from __future__ import annotations
@@ -129,9 +132,8 @@ def decode(proto_msg: str, data: bytes):
             )
         if proto_msg == "V1LayerParameter" and name == "layer":
             raise ProtoBinError(
-                "V0-era binary net (nested 'layer' connection messages) "
-                "is not supported; upgrade the text form via "
-                "upgrade_net_proto_text"
+                "V0-era connection message outside a NetParameter "
+                "context; decode the whole net via load_net_binary"
             )
         if name not in ftypes:
             continue  # e.g. BlobProto double_data
@@ -150,7 +152,13 @@ def decode(proto_msg: str, data: bytes):
             sub_msg = ftype
             if proto_msg == "NetParameter" and name == "layers":
                 sub_msg = "V1LayerParameter"
-            sub = decode(sub_msg, bytes(value))
+            if sub_msg == "NetParameter" and net_needs_v0_upgrade(
+                bytes(value)
+            ):
+                # V0-era net embedded in a solver: shared token upgrade
+                sub = _load_v0_net(bytes(value))
+            else:
+                sub = decode(sub_msg, bytes(value))
             if repeated:
                 getattr(obj, name).append(sub)
             else:
@@ -174,6 +182,100 @@ def decode(proto_msg: str, data: bytes):
                 _scalar_from_wire(proto_msg, ftype, wiretype, value),
             )
     return obj
+
+
+# ---------------------------------------------------------------------------
+# V0-era nets: decode to prototext token dicts and reuse the V0 text
+# upgrade (UpgradeV0Net analog; reference handles V0 *binary* nets the
+# same way text ones are handled — upgrade_proto.cpp:21-80 runs on the
+# parsed proto regardless of which reader produced it)
+# ---------------------------------------------------------------------------
+
+def net_needs_v0_upgrade(data: bytes) -> bool:
+    """``NetNeedsV0ToV1Upgrade`` (upgrade_proto.cpp:82-89): any ``layers``
+    entry carrying the nested V0 ``layer`` connection message."""
+    for num, wiretype, value in wire.iter_fields(data):
+        if num == 2 and wiretype == 2:  # NetParameter.layers
+            for n2, w2, _ in wire.iter_fields(bytes(value)):
+                if n2 == 1 and w2 == 2:  # V1LayerParameter.layer
+                    return True
+    return False
+
+
+def _to_token(value, ftype: str) -> str:
+    """A decoded scalar -> the text token form ``prototext._bind`` expects
+    (strings carry the tokenizer's quote marker; enums/numbers are bare)."""
+    if ftype == "bool":
+        return "true" if value else "false"
+    if ftype == "string":
+        return "\0STR" + str(value)
+    if ftype in ("float", "double"):
+        return repr(float(value))
+    if ftype in _VARINT_TYPES:
+        return str(int(value))
+    return str(value)  # enum NAME
+
+
+def _decode_tokens(proto_msg: str, data: bytes) -> Dict[str, List[Any]]:
+    """Serialized message -> prototext-style token dict
+    ``{field: [tokens-or-subdicts...]}``; used for schema-less legacy
+    messages (V0LayerParameter) that only exist to be upgraded."""
+    table = FIELDS[proto_msg]
+    out: Dict[str, List[Any]] = {}
+    for num, wiretype, value in wire.iter_fields(data):
+        if num not in table:
+            continue
+        name, label, ftype = table[num]
+        if name == "blobs" and proto_msg in (
+            "V0LayerParameter", "V1LayerParameter", "LayerParameter"
+        ):
+            raise ProtoBinError(
+                "layer carries weight blobs — this is a weights file; "
+                "use io/caffemodel.py (load_weights) for it"
+            )
+        # V1 legacy share-name string -> ParamSpec.name (same rule as
+        # decode(); V1 entries can sit next to V0 ones in one file)
+        if proto_msg == "V1LayerParameter" and name == "param":
+            out.setdefault("param", []).append(
+                {"name": ["\0STR" + bytes(value).decode("utf-8")]}
+            )
+            continue
+        if ftype in FIELDS:
+            out.setdefault(name, []).append(
+                _decode_tokens(ftype, bytes(value))
+            )
+            continue
+        if wiretype == 2 and ftype not in ("string", "bytes"):
+            vals = _packed_scalars(proto_msg, ftype, value)
+        else:
+            vals = [_scalar_from_wire(proto_msg, ftype, wiretype, value)]
+        out.setdefault(name, []).extend(_to_token(v, ftype) for v in vals)
+    return out
+
+
+def _load_v0_net(data: bytes) -> schema.NetParameter:
+    from sparknet_tpu.config import prototext
+
+    d = _decode_tokens("NetParameter", data)
+    prototext._upgrade_v0_tokens(d)
+    # token-level _merge_v1_param_multipliers: entries carrying BOTH
+    # param share-names and blobs_lr merge them into the same ParamSpec
+    # (must happen before _bind, whose _upgrade_net clears blobs_lr)
+    for e in d.get("layers", []):
+        if not (isinstance(e, dict) and e.get("param") and e.get("blobs_lr")):
+            continue
+        params, lrs = e["param"], e["blobs_lr"]
+        wds = e.get("weight_decay", [])
+        while len(params) < len(lrs):
+            params.append({})
+        for i, lr in enumerate(lrs):
+            params[i]["lr_mult"] = [lr]
+            if i < len(wds):
+                params[i]["decay_mult"] = [wds[i]]
+        e.pop("blobs_lr", None)
+        e.pop("weight_decay", None)
+    # _bind finishes with _upgrade_net (blobs_lr -> ParamSpec, V1 names)
+    return prototext._bind(schema.NetParameter, d, permissive=False)
 
 
 # ---------------------------------------------------------------------------
@@ -263,11 +365,15 @@ def _merge_v1_param_multipliers(net: schema.NetParameter) -> None:
 
 
 def load_net_binary(path: str) -> schema.NetParameter:
-    """Binary NetParameter file -> upgraded modern schema object."""
+    """Binary NetParameter file -> upgraded modern schema object
+    (V0-era nets route through the shared V0 token upgrade)."""
     from sparknet_tpu.config.prototext import _upgrade_net
 
     with open(path, "rb") as f:
-        net = decode("NetParameter", f.read())
+        data = f.read()
+    if net_needs_v0_upgrade(data):
+        return _load_v0_net(data)
+    net = decode("NetParameter", data)
     _merge_v1_param_multipliers(net)
     _upgrade_net(net)
     return net

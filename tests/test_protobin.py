@@ -179,3 +179,212 @@ def test_extension_fields_roundtrip():
     assert back.layer[2].elu_param.alpha == 0.75
     assert back.layer[3].scale_param.bias_term is True
     assert back.layer[0].input_param.shape[0].dim == [1, 3, 9, 9]
+
+
+def _v0_conn(inner: bytes, bottom=(), top=()) -> bytes:
+    """One V0-era connection, wrapped as NetParameter.layers (field 2):
+    V1LayerParameter{layer=1 bottom=2 top=3}."""
+    out = wire.field_bytes(1, inner)
+    for b in bottom:
+        out += wire.field_bytes(2, b)
+    for t in top:
+        out += wire.field_bytes(3, t)
+    return wire.field_bytes(2, out)
+
+
+def _f32(field, v):
+    return wire.tag(field, 5) + np.float32(v).tobytes()
+
+
+def test_v0_binary_net_upgrades(tmp_path):
+    """A synthesized V0-era binary net (nested `layer` connection
+    messages, padding layer, flat per-type fields) upgrades end-to-end
+    through upgrade_net_proto_binary — `UpgradeV0Net` parity
+    (upgrade_proto.cpp:21-80; round-3 verdict item 7)."""
+    # V0LayerParameter: name=1 type=2 num_output=3 kernelsize=8 stride=10
+    # pool=11 pad=7 blobs_lr=51 weight_decay=52
+    pad_l = (
+        wire.field_bytes(1, b"pad1")
+        + wire.field_bytes(2, b"padding")
+        + wire.field_varint(7, 2)
+    )
+    conv = (
+        wire.field_bytes(1, b"conv1")
+        + wire.field_bytes(2, b"conv")
+        + wire.field_varint(3, 4)   # num_output
+        + wire.field_varint(8, 3)   # kernelsize
+        + wire.field_varint(10, 1)  # stride
+        + _f32(51, 1.0) + _f32(51, 2.0)   # blobs_lr
+        + _f32(52, 1.0) + _f32(52, 0.0)   # weight_decay
+    )
+    pool = (
+        wire.field_bytes(1, b"pool1")
+        + wire.field_bytes(2, b"pool")
+        + wire.field_varint(8, 2)
+        + wire.field_varint(10, 2)
+        + wire.field_varint(11, 1)  # PoolMethod AVE
+    )
+    loss = (
+        wire.field_bytes(1, b"loss")
+        + wire.field_bytes(2, b"softmax_loss")
+    )
+    net = (
+        wire.field_bytes(1, b"v0net")
+        + wire.field_bytes(3, b"data")      # input
+        + wire.field_bytes(3, b"label")
+        + wire.field_varint(4, 1) + wire.field_varint(4, 3)
+        + wire.field_varint(4, 8) + wire.field_varint(4, 8)  # input_dim
+        + _v0_conn(pad_l, [b"data"], [b"pad1"])
+        + _v0_conn(conv, [b"pad1"], [b"conv1"])
+        + _v0_conn(pool, [b"conv1"], [b"pool1"])
+        + _v0_conn(loss, [b"pool1", b"label"], [b"loss"])
+    )
+    src = tmp_path / "v0.binaryproto"
+    src.write_bytes(net)
+
+    assert protobin.net_needs_v0_upgrade(net)
+    netp = protobin.load_net_binary(str(src))
+    assert netp.name == "v0net"
+    types = [l.type for l in netp.layer]
+    # padding layer folded away; modern type names
+    assert types == ["Convolution", "Pooling", "SoftmaxWithLoss"]
+    c, p, s = netp.layer
+    assert c.convolution_param.num_output == 4
+    assert c.convolution_param.kernel_size == [3]
+    assert c.convolution_param.pad == [2]          # from the padding layer
+    assert list(c.bottom) == ["data"]              # rewired past padding
+    assert [ps.lr_mult for ps in c.param] == [1.0, 2.0]
+    assert [ps.decay_mult for ps in c.param] == [1.0, 0.0]
+    assert p.pooling_param.pool == "AVE"
+    assert p.pooling_param.kernel_size == 2
+    assert p.pooling_param.stride == 2
+    assert list(s.bottom) == ["pool1", "label"]
+
+    # no refusal path for weight-less V0 nets: the CLI upgrader writes a
+    # modern binary that round-trips to a fixed point
+    from sparknet_tpu.tools import cli
+
+    out = tmp_path / "upgraded.binaryproto"
+    assert cli.main(
+        ["upgrade_net_proto_binary", str(src), str(out)]
+    ) == 0
+    back = protobin.load_net_binary(str(out))
+    assert prototext.dumps(back) == prototext.dumps(netp)
+
+
+def test_v0_binary_weight_file_refused(tmp_path):
+    inner = wire.field_bytes(1, b"ip") + wire.field_bytes(
+        50, wire.field_varint(2, 1)  # V0 blobs
+    )
+    data = _v0_conn(inner)
+    p = tmp_path / "v0w.binaryproto"
+    p.write_bytes(wire.field_bytes(1, b"n") + data)
+    with pytest.raises(protobin.ProtoBinError, match="caffemodel"):
+        protobin.load_net_binary(str(p))
+
+
+def test_v0_text_padding_folds_too():
+    """The padding fold is shared with the text path (UpgradeV0Net runs
+    the same regardless of reader)."""
+    netp = config.parse(
+        """
+        name: "v0t"
+        input: "data"
+        input_dim: 1 input_dim: 3 input_dim: 8 input_dim: 8
+        layers { layer { name: "pad1" type: "padding" pad: 1 }
+                 bottom: "data" top: "pad1" }
+        layers { layer { name: "conv1" type: "conv" num_output: 2
+                         kernelsize: 3 }
+                 bottom: "pad1" top: "conv1" }
+        """,
+        config.NetParameter,
+    )
+    (c,) = netp.layer
+    assert c.type == "Convolution"
+    assert c.convolution_param.pad == [1]
+    assert list(c.bottom) == ["data"]
+
+
+def test_v0_weight_file_loads_via_caffemodel(tmp_path):
+    """The refusal's guidance must not be circular: caffemodel
+    load_weights reads V0-era nested blobs (layers=2 -> layer=1 ->
+    blobs=50)."""
+    from sparknet_tpu.io import caffemodel
+
+    blob = (
+        wire.field_varint(1, 1) + wire.field_varint(2, 1)
+        + wire.field_varint(3, 1) + wire.field_varint(4, 2)
+        + _f32(5, 3.0) + _f32(5, 4.0)
+    )
+    inner = wire.field_bytes(1, b"ip") + wire.field_bytes(50, blob)
+    data = wire.field_bytes(1, b"v0w") + _v0_conn(inner)
+    p = tmp_path / "v0.caffemodel"
+    p.write_bytes(data)
+    w = caffemodel.load_weights(str(p))
+    assert list(w) == ["ip"]
+    np.testing.assert_allclose(
+        w["ip"][0].reshape(-1), [3.0, 4.0]
+    )
+
+
+def test_mixed_v0_v1_binary_net(tmp_path):
+    """V1 entries (enum type, legacy param string, blobs_lr) sitting next
+    to V0 connections in one file upgrade together; V1-carried weight
+    blobs are still refused on the token path."""
+    v0 = wire.field_bytes(1, b"c1") + wire.field_bytes(2, b"conv") \
+        + wire.field_varint(3, 2) + wire.field_varint(8, 3)
+    v1 = (
+        wire.field_bytes(4, b"ip1")
+        + wire.field_varint(5, 14)  # INNER_PRODUCT
+        + _f32(7, 3.0)              # blobs_lr
+        + wire.field_bytes(1001, b"shared_w")
+        + wire.field_bytes(2, b"c1") + wire.field_bytes(3, b"ip1")
+    )
+    net = (
+        wire.field_bytes(1, b"mixed")
+        + wire.field_bytes(3, b"data")
+        + wire.field_varint(4, 1) + wire.field_varint(4, 3)
+        + wire.field_varint(4, 8) + wire.field_varint(4, 8)
+        + _v0_conn(v0, [b"data"], [b"c1"])
+        + wire.field_bytes(2, v1)
+    )
+    p = tmp_path / "mixed.binaryproto"
+    p.write_bytes(net)
+    netp = protobin.load_net_binary(str(p))
+    assert [l.type for l in netp.layer] == ["Convolution", "InnerProduct"]
+    ip = netp.layer[1]
+    # share-name string and blobs_lr merged into the SAME ParamSpec
+    assert ip.param[0].name == "shared_w"
+    assert ip.param[0].lr_mult == 3.0
+    assert not ip.blobs_lr
+
+    # V1-carried weights refuse on the token path too
+    v1_w = wire.field_bytes(4, b"w") + wire.field_bytes(
+        6, wire.field_varint(1, 1)
+    )
+    bad = _v0_conn(v0, [b"data"], [b"c1"]) + wire.field_bytes(2, v1_w)
+    p2 = tmp_path / "mixed_w.binaryproto"
+    p2.write_bytes(bad)
+    with pytest.raises(protobin.ProtoBinError, match="caffemodel"):
+        protobin.load_net_binary(str(p2))
+
+
+def test_solver_with_embedded_v0_net(tmp_path):
+    """Solver-embedded V0 nets upgrade too (ReadSolverParamsFromBinary
+    runs UpgradeNetAsNeeded on every embedded net)."""
+    inner = (
+        wire.field_bytes(1, b"fc") + wire.field_bytes(2, b"innerproduct")
+        + wire.field_varint(3, 5)
+    )
+    embedded = (
+        wire.field_bytes(3, b"data")
+        + wire.field_varint(4, 1) + wire.field_varint(4, 4)
+        + _v0_conn(inner, [b"data"], [b"fc"])
+    )
+    sp_bytes = wire.field_bytes(25, embedded)  # net_param
+    p = tmp_path / "v0solver.bin"
+    p.write_bytes(sp_bytes)
+    sp = protobin.load_solver_binary(str(p))
+    (layer,) = sp.net_param.layer
+    assert layer.type == "InnerProduct"
+    assert layer.inner_product_param.num_output == 5
