@@ -157,6 +157,61 @@ def _warp(region: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
     return arr
 
 
+def crop_window(
+    img: np.ndarray,
+    x1, y1, x2, y2,
+    crop: int,
+    context_pad: int = 0,
+    square: bool = False,
+    do_mirror: bool = False,
+):
+    """Crop one window from an (H, W, C) image, with R-CNN context
+    padding and warp-to-square (``window_data_layer.cpp:246-375``
+    semantics, shared by training batches and the Detector driver).
+
+    Returns ``(out, pad_h, pad_w, (warped_h, warped_w))`` where ``out``
+    is (crop, crop, C) float32 with zero padding outside the warped
+    content region."""
+    pad = int(context_pad)
+    h_img, w_img = img.shape[:2]
+    pad_w = pad_h = 0
+    out_h = out_w = crop
+    if pad > 0 or square:
+        context_scale = crop / float(crop - 2 * pad)
+        half_h = (y2 - y1 + 1) / 2.0
+        half_w = (x2 - x1 + 1) / 2.0
+        cx, cy = x1 + half_w, y1 + half_h
+        if square:
+            half_h = half_w = max(half_h, half_w)
+        x1 = int(round(cx - half_w * context_scale))
+        x2 = int(round(cx + half_w * context_scale))
+        y1 = int(round(cy - half_h * context_scale))
+        y2 = int(round(cy + half_h * context_scale))
+        un_h, un_w = y2 - y1 + 1, x2 - x1 + 1
+        pad_x1, pad_y1 = max(0, -x1), max(0, -y1)
+        pad_x2 = max(0, x2 - w_img + 1)
+        pad_y2 = max(0, y2 - h_img + 1)
+        x1, x2 = x1 + pad_x1, x2 - pad_x2
+        y1, y2 = y1 + pad_y1, y2 - pad_y2
+        scale_x, scale_y = crop / float(un_w), crop / float(un_h)
+        out_w = int(round((x2 - x1 + 1) * scale_x))
+        out_h = int(round((y2 - y1 + 1) * scale_y))
+        pad_h = int(round(pad_y1 * scale_y))
+        # mirrored windows mirror their padding too (:370-375)
+        pad_w = int(round((pad_x2 if do_mirror else pad_x1) * scale_x))
+        out_h = min(out_h, crop - pad_h)
+        out_w = min(out_w, crop - pad_w)
+    region = img[int(y1):int(y2) + 1, int(x1):int(x2) + 1]
+    warped = _warp(region, out_h, out_w)
+    if do_mirror:
+        warped = warped[:, ::-1]
+    out = np.zeros((crop, crop, img.shape[2]), np.float32)
+    out[pad_h:pad_h + warped.shape[0], pad_w:pad_w + warped.shape[1]] = (
+        warped
+    )
+    return out, pad_h, pad_w, warped.shape[:2]
+
+
 class WindowSampler:
     """Batch sampler with the reference's fg/bg composition and
     context-pad warp; emits (data (B, C, crop, crop) f32, label (B,))."""
@@ -214,46 +269,12 @@ class WindowSampler:
         return self._cache[idx]
 
     def _crop_window(self, img: np.ndarray, x1, y1, x2, y2, do_mirror):
-        crop = self.crop
-        pad = int(self.p.context_pad)
-        square = self.p.crop_mode == "square"
-        h_img, w_img = img.shape[:2]
-        pad_w = pad_h = 0
-        out_h = out_w = crop
-        if pad > 0 or square:
-            context_scale = crop / float(crop - 2 * pad)
-            half_h = (y2 - y1 + 1) / 2.0
-            half_w = (x2 - x1 + 1) / 2.0
-            cx, cy = x1 + half_w, y1 + half_h
-            if square:
-                half_h = half_w = max(half_h, half_w)
-            x1 = int(round(cx - half_w * context_scale))
-            x2 = int(round(cx + half_w * context_scale))
-            y1 = int(round(cy - half_h * context_scale))
-            y2 = int(round(cy + half_h * context_scale))
-            un_h, un_w = y2 - y1 + 1, x2 - x1 + 1
-            pad_x1, pad_y1 = max(0, -x1), max(0, -y1)
-            pad_x2 = max(0, x2 - w_img + 1)
-            pad_y2 = max(0, y2 - h_img + 1)
-            x1, x2 = x1 + pad_x1, x2 - pad_x2
-            y1, y2 = y1 + pad_y1, y2 - pad_y2
-            scale_x, scale_y = crop / float(un_w), crop / float(un_h)
-            out_w = int(round((x2 - x1 + 1) * scale_x))
-            out_h = int(round((y2 - y1 + 1) * scale_y))
-            pad_h = int(round(pad_y1 * scale_y))
-            # mirrored windows mirror their padding too (:370-375)
-            pad_w = int(round((pad_x2 if do_mirror else pad_x1) * scale_x))
-            out_h = min(out_h, crop - pad_h)
-            out_w = min(out_w, crop - pad_w)
-        region = img[int(y1):int(y2) + 1, int(x1):int(x2) + 1]
-        warped = _warp(region, out_h, out_w)
-        if do_mirror:
-            warped = warped[:, ::-1]
-        out = np.zeros((crop, crop, img.shape[2]), np.float32)
-        out[pad_h:pad_h + warped.shape[0], pad_w:pad_w + warped.shape[1]] = (
-            warped
+        return crop_window(
+            img, x1, y1, x2, y2, self.crop,
+            context_pad=int(self.p.context_pad),
+            square=self.p.crop_mode == "square",
+            do_mirror=do_mirror,
         )
-        return out, pad_h, pad_w, warped.shape[:2]
 
     def next_batch(self) -> Tuple[np.ndarray, np.ndarray]:
         p = self.p

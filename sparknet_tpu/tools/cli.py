@@ -474,7 +474,7 @@ def cmd_upgrade_net_proto_text(args) -> int:
 
 
 def cmd_upgrade_net_proto_binary(args) -> int:
-    """``upgrade_net_proto_binary IN OUT`` — rewrite a legacy (V1)
+    """``upgrade_net_proto_binary IN OUT`` — rewrite a legacy (V0/V1)
     *binary* NetParameter in the modern binary format (reference:
     ``caffe/tools/upgrade_net_proto_binary.cpp``; codec:
     ``io/protobin.py``).  Weight files are refused with a pointer to
@@ -502,6 +502,23 @@ def cmd_upgrade_solver_proto_text(args) -> int:
     with open(args.output, "w") as f:
         f.write(prototext.dumps(sp))
     print(f"Wrote upgraded solver to {args.output}")
+    return 0
+
+
+def cmd_draw_net(args) -> int:
+    """``draw_net NET OUT.dot`` — emit a graphviz visualization of a net
+    definition (reference: ``caffe/python/caffe/draw.py`` via
+    ``python/draw_net.py``; here dot source is written directly, render
+    with ``dot -Tpng OUT.dot -o OUT.png``)."""
+    from sparknet_tpu import config
+    from sparknet_tpu.tools import draw
+
+    netp = config.load_net_prototxt(args.input)
+    draw.draw_net_to_file(
+        netp, args.output, rankdir=args.rankdir,
+        label_edges=not args.no_edge_labels, phase=args.phase,
+    )
+    print(f"Drawing net to {args.output}")
     return 0
 
 
@@ -651,6 +668,14 @@ def main(argv=None) -> int:
         p.add_argument("input")
         p.add_argument("output")
         p.set_defaults(fn=fn)
+
+    p = sub.add_parser("draw_net")
+    p.add_argument("input")
+    p.add_argument("output")
+    p.add_argument("--rankdir", default="LR", choices=["LR", "TB", "BT", "RL"])
+    p.add_argument("--phase", default=None, choices=["TRAIN", "TEST"])
+    p.add_argument("--no_edge_labels", action="store_true")
+    p.set_defaults(fn=cmd_draw_net)
 
     p = sub.add_parser("compute_image_mean")
     p.add_argument("db")
