@@ -265,16 +265,26 @@ def bench_train():
 
 
 def bench_hostfeed():
-    """Full-path throughput: host pipeline -> Prefetcher -> device while
-    training — the CallbackBenchmarkSpec analog (the reference measured
-    its JNA callback feed the same way; BASELINE.md).  Fresh uint8
-    full-size batches stream through the Prefetcher each window and are
-    cropped/mean-subtracted on device; reports steady-state img/s next
-    to the device-resident number."""
+    """Full-path throughput: record DB -> native pipeline -> staged
+    host->device transfer -> training step — the CallbackBenchmarkSpec
+    analog (the reference measured its JNA callback feed the same way;
+    BASELINE.md).
+
+    Default path (BENCH_HOSTCROP=1): the native pipeline's u8 mode crops
+    on the host (uint8 row copies, 5.2x fewer bytes over the link than
+    float full-frames) and the mean/scale/mirror arithmetic fuses into
+    the jitted step (``finish_host_crops``).  BENCH_HOSTCROP=0 A/Bs the
+    full-frame path with on-device cropping.  Transfers are staged
+    strictly BETWEEN steps: on the remote-TPU tunnel a device_put that
+    overlaps an execute collapses to ~1/50th bandwidth (PERF.md).
+    """
+    import tempfile
+
     import jax
     import numpy as np
 
     from sparknet_tpu import models
+    from sparknet_tpu import runtime as rt
     from sparknet_tpu.config import replace_data_layers
     from sparknet_tpu.data import transforms
     from sparknet_tpu.data.prefetch import Prefetcher
@@ -284,6 +294,7 @@ def bench_hostfeed():
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     tau = int(os.environ.get("BENCH_TAU", "4"))
     rounds = int(os.environ.get("BENCH_ROUNDS", "3"))
+    hostcrop = os.environ.get("BENCH_HOSTCROP", "1") != "0"
     full, crop = 256, 227
 
     netp = replace_data_layers(
@@ -299,44 +310,15 @@ def bench_hostfeed():
         compute_dtype=None
         if os.environ.get("BENCH_DTYPE") in ("float32", "f32")
         else "bfloat16",
-        train_transform=transforms.train_transform(mean, crop),
+        train_transform=(
+            transforms.finish_host_crops(mean)
+            if hostcrop
+            else transforms.train_transform(mean, crop)
+        ),
     )
     state = solver.init_state(seed=0)
 
-    # a pool of pre-synthesized uint8 images stands in for the decode
-    # stage; each produced window is a fresh host->device transfer
-    pool = [
-        rng.randint(0, 256, (tau, batch, 3, full, full), np.uint8)
-        for _ in range(2)
-    ]
-    labels = rng.randint(0, 1000, (tau, batch)).astype(np.float32)
-    idx = [0]
-
-    def produce():
-        i = idx[0]
-        idx[0] += 1
-        return {"data": pool[i % len(pool)], "label": labels}
-
-    pf = Prefetcher(produce)
-    # warmup: compile
-    state, losses = solver.step(state, next(pf))
-    jax.block_until_ready(losses)
-    t0 = time.perf_counter()
-    for _ in range(rounds):
-        state, losses = solver.step(state, next(pf))
-    float(jnp_sum_scalar(losses))
-    elapsed = time.perf_counter() - t0
-    pf.stop()
-    img_s = batch * tau * rounds / elapsed
-
-    # host data plane alone (no device transfer): the native
-    # DataPipeline streaming full-size records out of a record DB with
-    # crop/mirror/mean applied in the reader thread — what the host side
-    # sustains independent of the host->device link
-    import tempfile
-
-    from sparknet_tpu import runtime as rt
-
+    # a real record DB feeds the native pipeline (decode stage stand-in)
     db_path = os.path.join(tempfile.mkdtemp(prefix="bench_db_"), "b.sndb")
     n_rec = batch * 2
     rt.write_datum_db(
@@ -344,22 +326,79 @@ def bench_hostfeed():
         rng.randint(0, 256, (n_rec, 3, full, full), np.uint8),
         rng.randint(0, 1000, n_rec),
     )
+    # hostcrop: u8 crop windows + geometry sidecar over the link;
+    # full-frame: raw u8 frames (device does crop/mirror/mean)
     pipe = rt.DataPipeline(
-        db_path, batch_size=batch, shape=(3, full, full), crop=crop,
-        mirror=True, train=True, mean=mean,
+        db_path, batch_size=batch, shape=(3, full, full),
+        crop=crop if hostcrop else 0, mirror=hostcrop, train=True,
+        u8_output=True, seed=1,
     )
-    pipe.next()  # warm
-    t0 = time.perf_counter()
-    nb = 8
-    for _ in range(nb):
-        pipe.next()
-    host_rate = batch * nb / (time.perf_counter() - t0)
-    pipe.close()
 
+    def produce():
+        parts = [pipe.next() for _ in range(tau)]
+        out = {
+            "data": np.stack([p[0] for p in parts]),
+            "label": np.stack([p[1] for p in parts]),
+        }
+        if hostcrop:
+            out["h_off"] = np.stack([p[2] for p in parts])
+            out["w_off"] = np.stack([p[3] for p in parts])
+            out["flip"] = np.stack([p[4] for p in parts])
+        return out
+
+    # producer thread makes HOST batches only; the device_put is staged
+    # on the consumer between steps (tunnel discipline)
+    pf = Prefetcher(produce, device_put=False)
+
+    def stage_and_step(state):
+        hb = next(pf)
+        db = jax.device_put(hb)
+        jax.block_until_ready(db["data"])
+        state, losses = solver.step(state, db)
+        return state, losses
+
+    state, losses = stage_and_step(state)  # compile + warm
+    jax.block_until_ready(losses)
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        state, losses = stage_and_step(state)
+    float(jnp_sum_scalar(losses))
+    elapsed = time.perf_counter() - t0
+    pf.stop()
+    pipe.close()
+    img_s = batch * tau * rounds / elapsed
+
+    # host data plane alone (no device transfer): what the host side
+    # sustains independent of the host->device link, in both modes
+    host_rates = {}
+    for mode, u8 in (("f32_full_transform", False), ("u8_hostcrop", True)):
+        p = rt.DataPipeline(
+            db_path, batch_size=batch, shape=(3, full, full), crop=crop,
+            mirror=True, train=True, mean=None if u8 else mean,
+            u8_output=u8, seed=2,
+        )
+        p.next()  # warm (spins up workers)
+        t0 = time.perf_counter()
+        nb = 12
+        for _ in range(nb):
+            p.next()
+        host_rates[mode] = batch * nb / (time.perf_counter() - t0)
+        p.close()
+
+    bytes_per_img = (
+        3 * crop * crop if hostcrop else 3 * full * full
+    )
     print(
-        "host-feed: %.1f img/s end-to-end (uint8 %dx%dx3 over the host "
-        "link, on-device crop to %d); host pipeline alone produces "
-        "%.1f img/s" % (img_s, full, full, crop, host_rate),
+        "host-feed (%s): %.1f img/s end-to-end (%.2f MB/s over the host "
+        "link); host pipeline alone: f32-transform %.1f img/s, "
+        "u8-hostcrop %.1f img/s"
+        % (
+            "u8 host-crop" if hostcrop else "u8 full-frame",
+            img_s,
+            img_s * bytes_per_img / 1e6,
+            host_rates["f32_full_transform"],
+            host_rates["u8_hostcrop"],
+        ),
         file=sys.stderr,
     )
     out = {
@@ -369,9 +408,21 @@ def bench_hostfeed():
         "vs_baseline": round(
             img_s / _MODEL_BASELINE_IMG_S.get(model, BASELINE_IMG_S), 3
         ),
-        "host_pipeline_images_per_sec": round(host_rate, 1),
-        "note": "full host->device pipeline (Prefetcher uint8 path) "
-        "while training",
+        "mode": "u8_hostcrop" if hostcrop else "u8_fullframe_devicecrop",
+        "host_pipeline_images_per_sec": round(
+            host_rates["u8_hostcrop" if hostcrop else "f32_full_transform"],
+            1,
+        ),
+        "host_pipeline_f32_images_per_sec": round(
+            host_rates["f32_full_transform"], 1
+        ),
+        "host_pipeline_u8crop_images_per_sec": round(
+            host_rates["u8_hostcrop"], 1
+        ),
+        "link_mb_per_sec": round(img_s * bytes_per_img / 1e6, 1),
+        "note": "staged transfers (no put/execute overlap; see PERF.md "
+        "tunnel analysis); native pipeline, %d workers default"
+        % (os.cpu_count() or 1),
     }
     print(json.dumps(out))
 

@@ -5,12 +5,15 @@
 //                      (caffe/src/caffe/util/db.cpp, db_leveldb.cpp,
 //                      db_lmdb.cpp) and the shim's create_db/write_to_db/
 //                      commit_db_txn (libccaffe/ccaffe.cpp:51-81)
-//  - BlockingQueue  <- caffe/src/caffe/util/blocking_queue.cpp
 //  - Pipeline       <- DataReader's single reader Body thread
-//                      (data_reader.cpp:80-117) + DataTransformer's
-//                      scale/crop/mirror/mean (data_transformer.cpp:19-132)
-//                      + BasePrefetchingDataLayer's prefetch depth
-//                      (base_data_layer.cpp:70-101, PREFETCH_COUNT=3)
+//                      (data_reader.cpp:80-117) + per-solver transformer
+//                      threads generalized to a worker pool +
+//                      DataTransformer's scale/crop/mirror/mean
+//                      (data_transformer.cpp:19-132) +
+//                      BasePrefetchingDataLayer's prefetch depth
+//                      (base_data_layer.cpp:70-101, PREFETCH_COUNT=3);
+//                      the BlockingQueue role (util/blocking_queue.cpp)
+//                      is the cv-guarded work/done queues inside
 //
 // Compute never happens here (XLA owns it); this is the host-side runtime
 // that keeps the chip fed. Exposed through a minimal C ABI consumed via
@@ -21,6 +24,11 @@
 // Values for the pipeline are CIFAR/Datum-style: 1 label byte + C*H*W
 // pixel bytes (planar, NCHW order).
 
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
@@ -28,6 +36,7 @@
 #include <cstring>
 #include <deque>
 #include <fstream>
+#include <map>
 #include <memory>
 #include <mutex>
 #include <random>
@@ -105,6 +114,29 @@ class RecordDB {
   }
 
   size_t NumRecords() const { return offsets_.size(); }
+  bool HasMap() const { return map_ != nullptr; }
+
+  // Zero-copy view of record idx's value, valid while the DB is open.
+  // Read mode only (requires the mmap LoadIndex sets up); the pipeline's
+  // reader thread uses this so record bytes are never copied — workers
+  // transform straight out of the page cache.
+  bool ValueView(size_t idx, const char** data, size_t* len,
+                 std::string* err = nullptr) {
+    if (idx >= offsets_.size() || map_ == nullptr) {
+      std::string msg = map_ == nullptr ? "db not mapped for view reads"
+                                        : "record index out of range";
+      if (err) *err = msg;
+      set_error(msg);
+      return false;
+    }
+    const char* p = static_cast<const char*>(map_) + size_t(offsets_[idx]);
+    uint32_t kl, vl;
+    std::memcpy(&kl, p, 4);
+    std::memcpy(&vl, p + 4 + kl, 4);
+    *data = p + 4 + kl + 4;
+    *len = vl;
+    return true;
+  }
 
   // Sequential cursor read; wraps are the caller's concern. On failure the
   // specific reason is written to *err (when given) as well as the global
@@ -135,6 +167,10 @@ class RecordDB {
                   path_);
     }
     return true;
+  }
+
+  ~RecordDB() {
+    if (map_ != nullptr) munmap(map_, map_len_);
   }
 
  private:
@@ -180,6 +216,17 @@ class RecordDB {
     }
     in_.clear();
     in_.seekg(sizeof(kMagic));
+    // map the validated file for the zero-copy ValueView path; fall back
+    // silently to stream reads if mmap is unavailable
+    int fd = open(path_.c_str(), O_RDONLY);
+    if (fd >= 0) {
+      void* m = mmap(nullptr, fsize, PROT_READ, MAP_PRIVATE, fd, 0);
+      close(fd);
+      if (m != MAP_FAILED) {
+        map_ = m;
+        map_len_ = fsize;
+      }
+    }
     return true;
   }
 
@@ -189,55 +236,41 @@ class RecordDB {
   std::vector<std::streampos> offsets_;
   std::deque<Record> pending_;
   std::mutex mu_;
+  void* map_ = nullptr;
+  size_t map_len_ = 0;
 };
 
 // ---------------------------------------------------------------------------
-// BlockingQueue (util/blocking_queue.cpp)
+// Pipeline: one reader thread + N transform workers + in-order delivery.
+//
+// Reference decomposition (round-4 rework): the reference runs a single
+// DB-reading Body thread per source (data_reader.cpp:80-99) and a
+// transformer per solver (base_data_layer.cpp:70-101); here one reader
+// feeds a worker pool that cooperates batch-by-batch, so the host plane
+// scales with cores while record order stays the deterministic
+// sequential-cursor order.  Per-record crop/mirror randomness comes from
+// a counter-based splitmix64 stream keyed on (seed, global record seq):
+// identical output for ANY worker count, and cheaply reproducible by the
+// pure-Python fallback.
 // ---------------------------------------------------------------------------
 
-template <typename T>
-class BlockingQueue {
- public:
-  explicit BlockingQueue(size_t cap) : cap_(cap) {}
-
-  bool Push(T&& item, std::atomic<bool>& stop) {
-    std::unique_lock<std::mutex> lk(mu_);
-    not_full_.wait(lk, [&] { return q_.size() < cap_ || stop.load(); });
-    if (stop.load()) return false;
-    q_.push_back(std::move(item));
-    not_empty_.notify_one();
-    return true;
-  }
-
-  bool Pop(T* item, std::atomic<bool>& stop) {
-    std::unique_lock<std::mutex> lk(mu_);
-    not_empty_.wait(lk, [&] { return !q_.empty() || stop.load(); });
-    if (q_.empty()) return false;
-    *item = std::move(q_.front());
-    q_.pop_front();
-    not_full_.notify_one();
-    return true;
-  }
-
-  void WakeAll() {
-    not_full_.notify_all();
-    not_empty_.notify_all();
-  }
-
- private:
-  size_t cap_;
-  std::deque<T> q_;
-  std::mutex mu_;
-  std::condition_variable not_full_, not_empty_;
-};
-
-// ---------------------------------------------------------------------------
-// Pipeline
-// ---------------------------------------------------------------------------
+inline uint64_t splitmix64(uint64_t& s) {
+  s += 0x9E3779B97F4A7C15ull;
+  uint64_t z = s;
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+  return z ^ (z >> 31);
+}
 
 struct Batch {
+  // f32 mode: data holds floats; u8 mode: data_u8 holds cropped bytes and
+  // the per-image geometry (offsets + flip) rides along for the device to
+  // finish mean/scale/mirror.
   std::vector<float> data;
+  std::vector<uint8_t> data_u8;
   std::vector<float> labels;
+  std::vector<int32_t> h_offs, w_offs;
+  std::vector<uint8_t> flips;
 };
 
 struct PipelineConfig {
@@ -249,119 +282,285 @@ struct PipelineConfig {
   std::vector<float> mean;  // empty, per-channel (C), or full image (C*H*W)
   int prefetch = 3;         // PREFETCH_COUNT
   uint32_t seed = 0;
+  int workers = 0;          // 0 = hardware_concurrency - 1 (min 1)
+  bool u8_output = false;   // geometry-only host path (device finishes)
+};
+
+struct BatchTask {
+  uint64_t id = 0;
+  // views into the mmapped DB (zero-copy; valid while the DB is open) or
+  // into `owned` when the file could not be mapped
+  std::vector<std::pair<const char*, size_t>> records;
+  std::vector<std::string> owned;
+  Batch out;
+  std::atomic<int> next_slot{0};
+  std::atomic<int> done_slots{0};
 };
 
 class Pipeline {
  public:
-  Pipeline(RecordDB* db, const PipelineConfig& cfg)
-      : db_(db), cfg_(cfg), queue_(cfg.prefetch), rng_(cfg.seed) {
+  Pipeline(RecordDB* db, const PipelineConfig& cfg) : db_(db), cfg_(cfg) {
     out_h_ = cfg_.crop > 0 ? cfg_.crop : cfg_.h;
     out_w_ = cfg_.crop > 0 ? cfg_.crop : cfg_.w;
-    thread_ = std::thread([this] { Run(); });
+    int workers = cfg_.workers;
+    if (workers <= 0) {
+      unsigned hc = std::thread::hardware_concurrency();
+      workers = hc > 1 ? static_cast<int>(hc - 1) : 1;
+    }
+    reader_ = std::thread([this] { ReadLoop(); });
+    for (int i = 0; i < workers; ++i) {
+      workers_.emplace_back([this] { WorkLoop(); });
+    }
   }
 
   ~Pipeline() {
     stop_.store(true);
-    queue_.WakeAll();
-    if (thread_.joinable()) thread_.join();
+    work_cv_.notify_all();
+    done_cv_.notify_all();
+    room_cv_.notify_all();
+    if (reader_.joinable()) reader_.join();
+    for (auto& t : workers_) {
+      if (t.joinable()) t.join();
+    }
     delete db_;
   }
 
   int out_h() const { return out_h_; }
   int out_w() const { return out_w_; }
+  bool u8_output() const { return cfg_.u8_output; }
 
-  bool Next(float* data_out, float* label_out) {
+  bool Next(void* data_out, float* label_out, int32_t* hoff_out,
+            int32_t* woff_out, uint8_t* flip_out) {
     Batch b;
-    if (!queue_.Pop(&b, stop_)) {
-      // Surface the reader thread's sticky error if it recorded one;
-      // otherwise this is an ordinary stop.
-      std::string err = GetError();
-      set_error(err.empty() ? "pipeline stopped" : err);
-      return false;
+    {
+      std::unique_lock<std::mutex> lk(done_mu_);
+      done_cv_.wait(lk, [&] {
+        return stop_.load() || done_.count(next_out_) != 0;
+      });
+      auto it = done_.find(next_out_);
+      if (it == done_.end()) {
+        std::string err = GetError();
+        set_error(err.empty() ? "pipeline stopped" : err);
+        return false;
+      }
+      b = std::move(it->second);
+      done_.erase(it);
+      ++next_out_;
     }
-    std::memcpy(data_out, b.data.data(), b.data.size() * sizeof(float));
+    room_cv_.notify_one();
+    if (cfg_.u8_output) {
+      std::memcpy(data_out, b.data_u8.data(), b.data_u8.size());
+      if (hoff_out)
+        std::memcpy(hoff_out, b.h_offs.data(),
+                    b.h_offs.size() * sizeof(int32_t));
+      if (woff_out)
+        std::memcpy(woff_out, b.w_offs.data(),
+                    b.w_offs.size() * sizeof(int32_t));
+      if (flip_out) std::memcpy(flip_out, b.flips.data(), b.flips.size());
+    } else {
+      std::memcpy(data_out, b.data.data(), b.data.size() * sizeof(float));
+    }
     std::memcpy(label_out, b.labels.data(), b.labels.size() * sizeof(float));
+    {
+      std::lock_guard<std::mutex> lk(pool_mu_);
+      pool_.push_back(std::move(b));
+    }
     return true;
   }
 
  private:
-  void Run() {
+  void ReadLoop() {
     const size_t n = db_->NumRecords();
     const size_t record_bytes = 1 + size_t(cfg_.c) * cfg_.h * cfg_.w;
     size_t idx = 0;
-    std::string key, value;
+    uint64_t id = 0;
     while (!stop_.load()) {
-      Batch b;
-      b.data.resize(size_t(cfg_.batch) * cfg_.c * out_h_ * out_w_);
-      b.labels.resize(cfg_.batch);
+      {
+        // bound in-flight batches to the prefetch depth
+        std::unique_lock<std::mutex> lk(done_mu_);
+        room_cv_.wait(lk, [&] {
+          return stop_.load() ||
+                 id < next_out_ + static_cast<uint64_t>(cfg_.prefetch);
+        });
+        if (stop_.load()) break;
+      }
+      auto task = std::make_shared<BatchTask>();
+      task->id = id++;
+      task->records.resize(cfg_.batch);
+      const bool views = db_->HasMap();
+      if (!views) task->owned.resize(cfg_.batch);
+      {
+        // recycled buffers: resize-to-same-size below is then a no-op,
+        // avoiding a full zero-fill of the batch per round
+        std::lock_guard<std::mutex> lk(pool_mu_);
+        if (!pool_.empty()) {
+          task->out = std::move(pool_.back());
+          pool_.pop_back();
+        }
+      }
+      AllocBatch(&task->out);
+      bool ok = true;
       for (int i = 0; i < cfg_.batch && !stop_.load(); ++i) {
         std::string read_err;
-        if (!db_->ReadAt(idx, &key, &value, &read_err)) {
-          SetError(read_err);
-          stop_.store(true);
-          break;
+        if (views) {
+          if (!db_->ValueView(idx, &task->records[i].first,
+                              &task->records[i].second, &read_err)) {
+            SetError(read_err);
+            stop_.store(true);
+            ok = false;
+            break;
+          }
+        } else {
+          if (!db_->ReadAt(idx, &scratch_key_, &task->owned[i], &read_err)) {
+            SetError(read_err);
+            stop_.store(true);
+            ok = false;
+            break;
+          }
+          task->records[i] = {task->owned[i].data(), task->owned[i].size()};
         }
         idx = (idx + 1) % n;  // epoch wrap, deterministic order like the
                               // reference's sequential cursor
         // Datum records carry a 1-byte label (<=255 classes) or a
         // 2-byte little-endian one (1000-class ImageNet); the width is
         // record length minus the known image size.
-        if (value.size() != record_bytes && value.size() != record_bytes + 1) {
-          SetError("record size mismatch: got " +
-                   std::to_string(value.size()) + ", want " +
-                   std::to_string(record_bytes) + " or " +
+        const size_t vs = task->records[i].second;
+        if (vs != record_bytes && vs != record_bytes + 1) {
+          SetError("record size mismatch: got " + std::to_string(vs) +
+                   ", want " + std::to_string(record_bytes) + " or " +
                    std::to_string(record_bytes + 1));
           stop_.store(true);
+          ok = false;
           break;
         }
-        Transform(value, &b.data[size_t(i) * cfg_.c * out_h_ * out_w_],
-                  &b.labels[i]);
       }
-      if (stop_.load()) break;
-      if (!queue_.Push(std::move(b), stop_)) break;
+      if (!ok || stop_.load()) break;
+      {
+        std::lock_guard<std::mutex> lk(work_mu_);
+        work_.push_back(std::move(task));
+      }
+      work_cv_.notify_all();
     }
-    queue_.WakeAll();
+    work_cv_.notify_all();
+    done_cv_.notify_all();
+  }
+
+  void WorkLoop() {
+    while (!stop_.load()) {
+      std::shared_ptr<BatchTask> task;
+      int slot = -1;
+      {
+        std::unique_lock<std::mutex> lk(work_mu_);
+        work_cv_.wait(lk, [&] { return stop_.load() || !work_.empty(); });
+        if (stop_.load()) break;
+        task = work_.front();
+        // claim + pop both happen under work_mu_, so slots run exactly
+        // 0..batch-1 and the last claim retires the task
+        slot = task->next_slot.fetch_add(1);
+        if (slot == cfg_.batch - 1) work_.pop_front();
+      }
+      TransformSlot(*task, slot);
+      if (task->done_slots.fetch_add(1) + 1 == cfg_.batch) {
+        {
+          std::lock_guard<std::mutex> lk(done_mu_);
+          done_.emplace(task->id, std::move(task->out));
+        }
+        done_cv_.notify_all();
+      }
+    }
+  }
+
+  void AllocBatch(Batch* b) {
+    const size_t img = size_t(cfg_.c) * out_h_ * out_w_;
+    if (cfg_.u8_output) {
+      b->data_u8.resize(size_t(cfg_.batch) * img);
+      b->h_offs.resize(cfg_.batch);
+      b->w_offs.resize(cfg_.batch);
+      b->flips.resize(cfg_.batch);
+    } else {
+      b->data.resize(size_t(cfg_.batch) * img);
+    }
+    b->labels.resize(cfg_.batch);
   }
 
   // DataTransformer semantics: crop (random in train, center in test),
-  // mirror (train only), mean subtraction, scale.
-  void Transform(const std::string& value, float* out, float* label) {
-    const uint8_t* bytes = reinterpret_cast<const uint8_t*>(value.data());
+  // mirror (train only), mean subtraction, scale.  Per-record randomness
+  // is the counter-based stream documented above.
+  void TransformSlot(BatchTask& task, int slot) {
+    const char* vdata = task.records[slot].first;
+    const size_t vsize = task.records[slot].second;
+    Batch& b = task.out;
+    const uint8_t* bytes = reinterpret_cast<const uint8_t*>(vdata);
     const size_t label_w =
-        value.size() - size_t(cfg_.c) * cfg_.h * cfg_.w;  // 1 or 2
-    *label = static_cast<float>(
+        vsize - size_t(cfg_.c) * cfg_.h * cfg_.w;  // 1 or 2
+    b.labels[slot] = static_cast<float>(
         label_w == 2 ? (unsigned(bytes[0]) | (unsigned(bytes[1]) << 8))
                      : bytes[0]);
     const uint8_t* img = bytes + label_w;
+
+    const uint64_t seq = task.id * uint64_t(cfg_.batch) + uint64_t(slot);
+    uint64_t rs = (uint64_t(cfg_.seed) * 0x9E3779B97F4A7C15ull) ^
+                  (seq * 0xBF58476D1CE4E5B9ull);
     int h_off = 0, w_off = 0;
     if (cfg_.crop > 0) {
       if (cfg_.train) {
-        h_off = static_cast<int>(rng_() % (cfg_.h - cfg_.crop + 1));
-        w_off = static_cast<int>(rng_() % (cfg_.w - cfg_.crop + 1));
+        h_off = static_cast<int>(splitmix64(rs) % uint64_t(cfg_.h - cfg_.crop + 1));
+        w_off = static_cast<int>(splitmix64(rs) % uint64_t(cfg_.w - cfg_.crop + 1));
       } else {
         h_off = (cfg_.h - cfg_.crop) / 2;
         w_off = (cfg_.w - cfg_.crop) / 2;
       }
     }
-    bool flip = cfg_.mirror && cfg_.train && (rng_() & 1);
-    const bool full_mean = cfg_.mean.size() ==
-                           size_t(cfg_.c) * cfg_.h * cfg_.w;
+    const bool flip = cfg_.mirror && cfg_.train && (splitmix64(rs) & 1);
+
+    const size_t out_img = size_t(cfg_.c) * out_h_ * out_w_;
+    if (cfg_.u8_output) {
+      // geometry only: contiguous row copies; arithmetic (mean/scale)
+      // and the mirror land on the device where they fuse into the step
+      uint8_t* dst = &b.data_u8[size_t(slot) * out_img];
+      for (int ch = 0; ch < cfg_.c; ++ch) {
+        for (int y = 0; y < out_h_; ++y) {
+          const uint8_t* src =
+              img + (size_t(ch) * cfg_.h + y + h_off) * cfg_.w + w_off;
+          std::memcpy(dst + (size_t(ch) * out_h_ + y) * out_w_, src, out_w_);
+        }
+      }
+      b.h_offs[slot] = h_off;
+      b.w_offs[slot] = w_off;
+      b.flips[slot] = flip ? 1 : 0;
+      return;
+    }
+
+    const bool full_mean =
+        cfg_.mean.size() == size_t(cfg_.c) * cfg_.h * cfg_.w;
     const bool chan_mean = cfg_.mean.size() == size_t(cfg_.c);
+    float* out = &b.data[size_t(slot) * out_img];
     for (int ch = 0; ch < cfg_.c; ++ch) {
       for (int y = 0; y < out_h_; ++y) {
-        for (int x = 0; x < out_w_; ++x) {
-          int sy = y + h_off;
-          int sx = x + w_off;
-          size_t src = (size_t(ch) * cfg_.h + sy) * cfg_.w + sx;
-          float v = static_cast<float>(img[src]);
-          if (full_mean) {
-            v -= cfg_.mean[src];  // mean indexed by the source window,
-                                  // like data_transformer.cpp
-          } else if (chan_mean) {
-            v -= cfg_.mean[ch];
+        const size_t src_row = (size_t(ch) * cfg_.h + y + h_off) * cfg_.w + w_off;
+        const uint8_t* src = img + src_row;
+        const float cm = chan_mean ? cfg_.mean[ch] : 0.0f;
+        const float* mrow = full_mean ? &cfg_.mean[src_row] : nullptr;
+        float* dst = out + (size_t(ch) * out_h_ + y) * out_w_;
+        if (!flip) {
+          // contiguous: compilers vectorize this u8->f32 + axpy row
+          if (mrow) {
+            for (int x = 0; x < out_w_; ++x)
+              dst[x] = (float(src[x]) - mrow[x]) * cfg_.scale;
+          } else {
+            for (int x = 0; x < out_w_; ++x)
+              dst[x] = (float(src[x]) - cm) * cfg_.scale;
           }
-          int dx = flip ? (out_w_ - 1 - x) : x;
-          out[(size_t(ch) * out_h_ + y) * out_w_ + dx] = v * cfg_.scale;
+        } else {
+          // mean indexed by the source window, output written mirrored
+          // (data_transformer.cpp:119-130)
+          if (mrow) {
+            for (int x = 0; x < out_w_; ++x)
+              dst[out_w_ - 1 - x] = (float(src[x]) - mrow[x]) * cfg_.scale;
+          } else {
+            for (int x = 0; x < out_w_; ++x)
+              dst[out_w_ - 1 - x] = (float(src[x]) - cm) * cfg_.scale;
+          }
         }
       }
     }
@@ -381,12 +580,25 @@ class Pipeline {
   RecordDB* db_;
   PipelineConfig cfg_;
   int out_h_, out_w_;
-  BlockingQueue<Batch> queue_;
-  std::mt19937 rng_;
+  std::string scratch_key_;
+
+  std::deque<std::shared_ptr<BatchTask>> work_;
+  std::mutex work_mu_;
+  std::condition_variable work_cv_;
+
+  std::map<uint64_t, Batch> done_;
+  uint64_t next_out_ = 0;
+  std::mutex done_mu_;
+  std::condition_variable done_cv_, room_cv_;
+
+  std::vector<Batch> pool_;
+  std::mutex pool_mu_;
+
   std::atomic<bool> stop_{false};
   std::mutex err_mutex_;
   std::string error_;
-  std::thread thread_;
+  std::thread reader_;
+  std::vector<std::thread> workers_;
 };
 
 }  // namespace
@@ -443,10 +655,10 @@ long sndb_read(void* db, long idx, char* keybuf, size_t keybuflen, char* buf,
 
 void sndb_close(void* db) { delete static_cast<RecordDB*>(db); }
 
-void* snpipe_create(const char* db_path, int batch, int c, int h, int w,
-                    int crop, int mirror, int train, float scale,
-                    const float* mean, int mean_len, unsigned seed,
-                    int prefetch) {
+void* snpipe_create2(const char* db_path, int batch, int c, int h, int w,
+                     int crop, int mirror, int train, float scale,
+                     const float* mean, int mean_len, unsigned seed,
+                     int prefetch, int workers, int u8_output) {
   RecordDB* db = RecordDB::Open(db_path, false);
   if (!db) return nullptr;
   if (db->NumRecords() == 0) {
@@ -466,6 +678,8 @@ void* snpipe_create(const char* db_path, int batch, int c, int h, int w,
   if (mean && mean_len > 0) cfg.mean.assign(mean, mean + mean_len);
   cfg.seed = seed;
   cfg.prefetch = prefetch > 0 ? prefetch : 3;
+  cfg.workers = workers;
+  cfg.u8_output = u8_output != 0;
   if (crop > 0 && (crop > h || crop > w)) {
     set_error("crop exceeds input");
     delete db;
@@ -474,8 +688,31 @@ void* snpipe_create(const char* db_path, int batch, int c, int h, int w,
   return new Pipeline(db, cfg);
 }
 
+void* snpipe_create(const char* db_path, int batch, int c, int h, int w,
+                    int crop, int mirror, int train, float scale,
+                    const float* mean, int mean_len, unsigned seed,
+                    int prefetch) {
+  return snpipe_create2(db_path, batch, c, h, w, crop, mirror, train, scale,
+                        mean, mean_len, seed, prefetch, /*workers=*/0,
+                        /*u8_output=*/0);
+}
+
 int snpipe_next(void* p, float* data_out, float* label_out) {
-  return static_cast<Pipeline*>(p)->Next(data_out, label_out) ? 0 : -1;
+  return static_cast<Pipeline*>(p)->Next(data_out, label_out, nullptr,
+                                         nullptr, nullptr)
+             ? 0
+             : -1;
+}
+
+// u8 mode: data_out is uint8 (B*C*crop*crop); hoff/woff (int32, B) and
+// flip (uint8, B) receive the per-image geometry for the device finish.
+int snpipe_next2(void* p, void* data_out, float* label_out,
+                 int32_t* hoff_out, int32_t* woff_out,
+                 uint8_t* flip_out) {
+  return static_cast<Pipeline*>(p)->Next(data_out, label_out, hoff_out,
+                                         woff_out, flip_out)
+             ? 0
+             : -1;
 }
 
 int snpipe_out_h(void* p) { return static_cast<Pipeline*>(p)->out_h(); }

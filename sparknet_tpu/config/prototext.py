@@ -427,13 +427,14 @@ def _fold_v0_padding(d: Dict[str, List[Any]]) -> None:
         for j, b in enumerate(e.get("bottom", []) if isinstance(e, dict) else []):
             bname = _tok_str(b)
             if bname not in blob_src:
-                # reference LOG(FATAL)s on unknown inputs here
-                # (upgrade_proto.cpp:142-144); a dangling bottom must not
-                # survive the fold silently
-                raise ValueError(
-                    f"V0 net: unknown blob input {bname!r} (no earlier "
-                    "top or net input produces it)"
-                )
+                # the reference LOG(FATAL)s on unknown inputs
+                # (upgrade_proto.cpp:142-144) because every blob there
+                # must come from a layer or net input; here externally-fed
+                # blobs (feed_shapes / replaceDataLayers flow) are
+                # legitimate.  Safe for the fold: a deleted padding
+                # layer's top is always registered in blob_src, so an
+                # unknown bottom can never dangle on one.
+                continue
             src = blob_src[bname]
             if not (isinstance(src, dict) and "layer" in src
                     and _v0_type(src) == "padding"):

@@ -6,6 +6,12 @@ flight on an InternalThread with an async H2D push (``base_data_layer.cpp:
 double-buffering is a producer thread + bounded queue, and the device push
 is ``jax.device_put`` (which on TPU overlaps with compute because transfers
 are async until the buffer is used).
+
+On a remote-TPU relay (the axon tunnel), overlapped transfers instead
+COLLAPSE throughput (PERF.md "Tunnel transfer degradation"): pass
+``device_put=False`` there and stage ``jax.device_put`` +
+``block_until_ready`` on the consumer between steps, as
+``bench.py bench_hostfeed`` does.
 """
 
 from __future__ import annotations

@@ -31,7 +31,55 @@ import numpy as np
 
 Batch = Dict[str, jax.Array]
 
-__all__ = ["train_transform", "test_transform", "from_transform_param"]
+__all__ = [
+    "train_transform",
+    "test_transform",
+    "finish_host_crops",
+    "from_transform_param",
+]
+
+
+def finish_host_crops(
+    mean: Optional[np.ndarray],
+    scale: float = 1.0,
+    data_key: str = "data",
+) -> Callable[[Batch, jax.Array], Batch]:
+    """Device-side finish for the native pipeline's ``u8_output`` mode:
+    the host shipped uint8 crop *windows* plus their geometry
+    (``h_off``/``w_off``/``flip`` batch keys); this subtracts the mean
+    over each image's source window (dynamic-sliced from the full mean
+    image — data_transformer.cpp:49-58 semantics), scales, and applies
+    the mirror, all fused into the training step.  The rng argument is
+    ignored (randomness was drawn on the host, deterministically)."""
+    mean_arr = None if mean is None else jnp.asarray(mean, jnp.float32)
+
+    def fn(batch: Batch, rng=None) -> Batch:
+        x = batch[data_key].astype(jnp.float32)
+        crop_h, crop_w = x.shape[-2], x.shape[-1]
+        if mean_arr is not None:
+            if mean_arr.ndim == 1 or mean_arr.shape[-2:] == (1, 1):
+                x = x - mean_arr.reshape(-1, 1, 1)
+            else:
+                mwin = jax.vmap(
+                    lambda ho, wo: jax.lax.dynamic_slice(
+                        mean_arr,
+                        (0, ho, wo),
+                        (mean_arr.shape[0], crop_h, crop_w),
+                    )
+                )(batch["h_off"], batch["w_off"])
+                x = x - mwin
+        if scale != 1.0:
+            x = x * scale
+        flips = batch["flip"].astype(bool)
+        x = jnp.where(flips[:, None, None, None], x[..., ::-1], x)
+        new = {
+            k: v for k, v in batch.items()
+            if k not in ("h_off", "w_off", "flip")
+        }
+        new[data_key] = x
+        return new
+
+    return fn
 
 
 def _crop_one(img, mean, h_off, w_off, crop: int, flip, scale: float):

@@ -35,6 +35,14 @@ def _load():
     except OSError as e:
         _lib_error = str(e)
         return None
+    if not hasattr(lib, "snpipe_create2"):
+        # a stale pre-rework .so: fall back to Python (rebuildable with
+        # `make -C native` / runtime.build(force=True))
+        _lib_error = (
+            "libsparknet_runtime.so is outdated (missing snpipe_create2); "
+            "rebuild with `make -C native`"
+        )
+        return None
     lib.sn_last_error.restype = ctypes.c_char_p
     lib.sndb_open.restype = ctypes.c_void_p
     lib.sndb_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
@@ -59,8 +67,8 @@ def _load():
         ctypes.c_size_t,
     ]
     lib.sndb_close.argtypes = [ctypes.c_void_p]
-    lib.snpipe_create.restype = ctypes.c_void_p
-    lib.snpipe_create.argtypes = [
+    lib.snpipe_create2.restype = ctypes.c_void_p
+    lib.snpipe_create2.argtypes = [
         ctypes.c_char_p,
         ctypes.c_int,
         ctypes.c_int,
@@ -74,9 +82,18 @@ def _load():
         ctypes.c_int,
         ctypes.c_uint,
         ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
     ]
-    lib.snpipe_next.restype = ctypes.c_int
-    lib.snpipe_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+    lib.snpipe_next2.restype = ctypes.c_int
+    lib.snpipe_next2.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+    ]
     lib.snpipe_out_h.restype = ctypes.c_int
     lib.snpipe_out_h.argtypes = [ctypes.c_void_p]
     lib.snpipe_out_w.restype = ctypes.c_int
@@ -91,7 +108,9 @@ def build(force: bool = False) -> bool:
     global _lib, _lib_error
     if os.path.exists(_LIB_PATH) and not force:
         _lib_error = None
-        return _load() is not None
+        if _load() is not None:
+            return True
+        # present but unloadable/stale: fall through and rebuild
     try:
         subprocess.run(
             ["make", "-C", _NATIVE_DIR], check=True, capture_output=True
@@ -250,10 +269,44 @@ def write_datum_db(
 # Pipeline
 # ---------------------------------------------------------------------------
 
+_U64 = (1 << 64) - 1
+
+
+def _record_rng_stream(seed: int, seq: int):
+    """The counter-based splitmix64 stream the native pipeline draws
+    per-record crop/mirror randomness from (runtime.cpp splitmix64):
+    keyed on (seed, global record sequence number), so output is
+    identical for any worker count and both implementations."""
+    s = ((seed * 0x9E3779B97F4A7C15) ^ (seq * 0xBF58476D1CE4E5B9)) & _U64
+
+    def next_u64():
+        nonlocal s
+        s = (s + 0x9E3779B97F4A7C15) & _U64
+        z = s
+        z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _U64
+        z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _U64
+        return z ^ (z >> 31)
+
+    return next_u64
+
 
 class DataPipeline:
-    """Threaded DB -> transformed float batches (reader thread + bounded
-    queue in native code; Python thread fallback otherwise)."""
+    """Threaded DB -> transformed batches (one reader + N transform
+    workers + ordered delivery in native code; Python thread fallback
+    otherwise).
+
+    Two output modes:
+
+    - float (default): full DataTransformer semantics on the host —
+      ``next()`` returns ``(data f32 (B,C,oh,ow), labels f32 (B,))``.
+    - ``u8_output=True``: the host applies only crop *geometry* (uint8
+      row copies — the cheap part) and ships the arithmetic to the
+      device where it fuses into the training step; ``next()`` returns
+      ``(data u8, labels, h_offs i32, w_offs i32, flips u8)``.  Finish
+      on device with ``data.transforms.finish_host_crops``.  This is
+      the low-byte path for weak host->device links (5x fewer bytes
+      than float full-frames).
+    """
 
     def __init__(
         self,
@@ -267,12 +320,15 @@ class DataPipeline:
         mean: Optional[np.ndarray] = None,
         seed: int = 0,
         prefetch: int = 3,
+        workers: int = 0,  # 0 = cores-1 (native); fallback always 1
+        u8_output: bool = False,
     ):
         self.batch_size = batch_size
         c, h, w = (int(x) for x in shape)
         self.c, self.h, self.w = c, h, w
         self.out_h = crop if crop else h
         self.out_w = crop if crop else w
+        self.u8_output = bool(u8_output)
         self._lib = _load()
         mean_arr = (
             np.ascontiguousarray(mean, dtype=np.float32).reshape(-1)
@@ -285,7 +341,7 @@ class DataPipeline:
                 if mean_arr is not None
                 else None
             )
-            self._handle = self._lib.snpipe_create(
+            self._handle = self._lib.snpipe_create2(
                 db_path.encode(),
                 batch_size,
                 c,
@@ -299,6 +355,8 @@ class DataPipeline:
                 0 if mean_arr is None else mean_arr.size,
                 seed,
                 prefetch,
+                workers,
+                int(u8_output),
             )
             if not self._handle:
                 raise IOError(f"snpipe_create failed: {_err(self._lib)}")
@@ -311,19 +369,24 @@ class DataPipeline:
         db = RecordDB(db_path, "r")
         if len(db) == 0:
             raise IOError("empty db")
-        rng = np.random.RandomState(seed)
         record_bytes = 1 + self.c * self.h * self.w
         self._py_q: "_queue.Queue" = _queue.Queue(maxsize=prefetch)
         self._py_stop = threading.Event()
+        u8 = self.u8_output
 
         def run():
             idx = 0
+            seq = 0
             n = len(db)
             while not self._py_stop.is_set():
+                dtype = np.uint8 if u8 else np.float32
                 data = np.empty(
-                    (self.batch_size, self.c, self.out_h, self.out_w), np.float32
+                    (self.batch_size, self.c, self.out_h, self.out_w), dtype
                 )
                 labels = np.empty(self.batch_size, np.float32)
+                h_offs = np.zeros(self.batch_size, np.int32)
+                w_offs = np.zeros(self.batch_size, np.int32)
+                flips = np.zeros(self.batch_size, np.uint8)
                 for i in range(self.batch_size):
                     _, value = db.read(idx)
                     idx = (idx + 1) % n
@@ -340,28 +403,42 @@ class DataPipeline:
                     labels[i] = int.from_bytes(value[:lw], "little")
                     img = np.frombuffer(value, np.uint8, offset=lw).reshape(
                         self.c, self.h, self.w
-                    ).astype(np.float32)
+                    )
+                    draw = _record_rng_stream(seed, seq)
+                    seq += 1
+                    ho = wo = 0
                     if crop:
                         if train:
-                            ho = rng.randint(0, self.h - crop + 1)
-                            wo = rng.randint(0, self.w - crop + 1)
+                            ho = draw() % (self.h - crop + 1)
+                            wo = draw() % (self.w - crop + 1)
                         else:
                             ho = (self.h - crop) // 2
                             wo = (self.w - crop) // 2
-                        img = img[:, ho : ho + crop, wo : wo + crop]
-                        if mean is not None and mean.size == self.c * self.h * self.w:
-                            m = mean.reshape(self.c, self.h, self.w)
-                            img = img - m[:, ho : ho + crop, wo : wo + crop]
-                    elif mean is not None and mean.size == self.c * self.h * self.w:
-                        img = img - mean.reshape(self.c, self.h, self.w)
-                    if mean is not None and mean.size == self.c:
-                        img = img - mean.reshape(self.c, 1, 1)
-                    if mirror and train and rng.randint(0, 2):
-                        img = img[:, :, ::-1]
-                    data[i] = img * scale
+                    flip = bool(mirror and train and (draw() & 1))
+                    window = (
+                        img[:, ho : ho + crop, wo : wo + crop] if crop else img
+                    )
+                    if u8:
+                        data[i] = window
+                        h_offs[i], w_offs[i], flips[i] = ho, wo, flip
+                        continue
+                    out = window.astype(np.float32)
+                    if mean is not None and mean.size == self.c * self.h * self.w:
+                        m = mean.reshape(self.c, self.h, self.w)
+                        out = out - m[:, ho : ho + self.out_h, wo : wo + self.out_w]
+                    elif mean is not None and mean.size == self.c:
+                        out = out - mean.reshape(self.c, 1, 1)
+                    if flip:
+                        out = out[:, :, ::-1]
+                    data[i] = out * scale
+                item = (
+                    (data, labels, h_offs, w_offs, flips)
+                    if u8
+                    else (data, labels)
+                )
                 while not self._py_stop.is_set():
                     try:
-                        self._py_q.put((data, labels), timeout=0.1)
+                        self._py_q.put(item, timeout=0.1)
                         break
                     except _queue.Full:
                         continue
@@ -370,16 +447,36 @@ class DataPipeline:
         self._py_thread.start()
 
     def next(self):
-        """Returns (data (B,C,oh,ow) float32, labels (B,) float32)."""
+        """float mode: ``(data f32, labels)``; u8 mode: ``(data u8,
+        labels, h_offs, w_offs, flips)``."""
         if self._handle is not None:
+            dtype = np.uint8 if self.u8_output else np.float32
             data = np.empty(
-                (self.batch_size, self.c, self.out_h, self.out_w), np.float32
+                (self.batch_size, self.c, self.out_h, self.out_w), dtype
             )
             labels = np.empty(self.batch_size, np.float32)
-            rc = self._lib.snpipe_next(
+            if self.u8_output:
+                h_offs = np.empty(self.batch_size, np.int32)
+                w_offs = np.empty(self.batch_size, np.int32)
+                flips = np.empty(self.batch_size, np.uint8)
+                rc = self._lib.snpipe_next2(
+                    self._handle,
+                    data.ctypes.data_as(ctypes.c_void_p),
+                    labels.ctypes.data_as(ctypes.c_void_p),
+                    h_offs.ctypes.data_as(ctypes.c_void_p),
+                    w_offs.ctypes.data_as(ctypes.c_void_p),
+                    flips.ctypes.data_as(ctypes.c_void_p),
+                )
+                if rc:
+                    raise IOError(_err(self._lib))
+                return data, labels, h_offs, w_offs, flips
+            rc = self._lib.snpipe_next2(
                 self._handle,
                 data.ctypes.data_as(ctypes.c_void_p),
                 labels.ctypes.data_as(ctypes.c_void_p),
+                None,
+                None,
+                None,
             )
             if rc:
                 raise IOError(_err(self._lib))

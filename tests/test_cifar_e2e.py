@@ -66,6 +66,7 @@ def test_random_init_scores_chance(loader):
     assert 0.7 <= acc * 10 <= 1.3
 
 
+@pytest.mark.slow
 def test_trains_above_chance_and_features(loader):
     solver = Solver(models.load_model_solver("cifar10_full"))
     state = solver.init_state(seed=0)

@@ -47,6 +47,7 @@ def test_create_db_artifacts(db_dir):
         assert len(db) == info["train_batches"][0] * info["train_batch"]
 
 
+@pytest.mark.slow
 def test_run_train_snapshot_resume_eval(db_dir, tmp_path, capsys):
     prefix = str(tmp_path / "snap" / "imagenet_db")
     common = [
@@ -71,6 +72,7 @@ def test_run_train_snapshot_resume_eval(db_dir, tmp_path, capsys):
     assert 0.0 <= acc <= 100.0
 
 
+@pytest.mark.slow
 def test_warm_start_from_caffemodel(db_dir, tmp_path, capsys):
     # phase A left model files next to the snapshots? write a fresh one:
     # run 1 round with snapshots into this test's own prefix

@@ -102,6 +102,7 @@ def test_make_pairs_labels(mnist_dir):
     assert 0 < same.sum() < 200
 
 
+@pytest.mark.slow
 def test_siamese_shared_towers_train(mnist_dir):
     solver = Solver(models.load_model_solver("mnist_siamese"))
     state = solver.init_state(seed=0)
@@ -217,6 +218,7 @@ def test_flickr_style_in_zoo_listing():
         assert required in names
 
 
+@pytest.mark.slow
 def test_cifar10_quick_shapes_and_training(tmp_path):
     """BASELINE config 1 (``examples/cifar10/cifar10_quick_*``): the
     quick net's pool-then-relu first stage and AVE pools, its fixed-lr
@@ -257,6 +259,7 @@ def test_cifar10_quick_shapes_and_training(tmp_path):
     assert int(st.iter) == int(state.iter)
 
 
+@pytest.mark.slow
 def test_mnist_autoencoder_dual_losses_and_training(mnist_dir):
     """``examples/mnist/mnist_autoencoder``: sparse gaussian fillers,
     SigmoidCrossEntropyLoss at weight 1 + monitoring EuclideanLoss at

@@ -39,6 +39,7 @@ def test_plan_finds_inception_groups(hfuse_env):
     assert fused_members > len(net._hconv_groups)
 
 
+@pytest.mark.slow
 def test_fused_forward_backward_exact(monkeypatch):
     netp = _tiny_googlenet()
     monkeypatch.setenv("SPARKNET_HFUSE", "0")

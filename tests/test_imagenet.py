@@ -187,6 +187,7 @@ def test_from_transform_param_paths():
         np.testing.assert_allclose(out3[:, 2], 5.0)
 
 
+@pytest.mark.slow
 def test_imagenet_app_e2e_synthetic_mesh():
     """The flagship driver end-to-end on the virtual mesh: synthetic JPEG
     shards -> tar streaming -> resize -> mean -> device-side crops ->

@@ -439,6 +439,7 @@ def test_lrn_fast_negpow_matches_pow():
         )
 
 
+@pytest.mark.slow
 def test_pallas_lrn_matches_xla_path():
     """The Pallas LRN kernel (interpret mode off-TPU) pins value and
     gradient against the XLA custom_vjp path."""

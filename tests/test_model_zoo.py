@@ -92,6 +92,7 @@ def test_resnet50_shapes_and_param_count():
     assert len(stats) == 53  # 53 BatchNorm layers in ResNet-50
 
 
+@pytest.mark.slow
 def test_googlenet_trains_one_step_tiny():
     # tiny spatial size to keep CPU time sane; exercises aux heads + concat
     from sparknet_tpu import config
@@ -112,6 +113,7 @@ def test_googlenet_trains_one_step_tiny():
     assert float(losses[0]) > np.log(8)
 
 
+@pytest.mark.slow
 def test_resnet50_trains_one_step_tiny():
     from sparknet_tpu import config
     from sparknet_tpu.solver import Solver

@@ -62,6 +62,7 @@ def test_wide_labels_roundtrip_native_and_python(native_built, tmp_path):
     p2 = runtime.DataPipeline.__new__(runtime.DataPipeline)
     p2.batch_size, p2.c, p2.h, p2.w = 8, 3, 6, 6
     p2.out_h = p2.out_w = 6
+    p2.u8_output = False
     p2._lib = None
     p2._handle = None
     p2._py_init(str(path), 0, False, True, 1.0, None, 0, 3)
@@ -181,3 +182,94 @@ def test_empty_db_rejected(native_built, tmp_path):
         db.commit()
     with pytest.raises(IOError, match="empty"):
         runtime.DataPipeline(str(path), batch_size=1, shape=(3, 8, 8))
+
+
+def test_pipeline_worker_count_invariance(native_built, tmp_path):
+    """Crop/mirror randomness is keyed on the global record sequence, so
+    any worker count produces identical batches in identical order."""
+    path = tmp_path / "wc.sndb"
+    _write_db(path, n=32)
+    mean = np.random.RandomState(3).rand(3, 8, 8).astype(np.float32) * 30
+    outs = []
+    for workers in (1, 4):
+        p = runtime.DataPipeline(
+            str(path), batch_size=8, shape=(3, 8, 8), crop=6, mirror=True,
+            train=True, mean=mean, seed=7, workers=workers,
+        )
+        batches = [p.next() for _ in range(5)]  # wraps the 32-record db
+        p.close()
+        outs.append(batches)
+    for (d1, l1), (d2, l2) in zip(*outs):
+        np.testing.assert_array_equal(l1, l2)
+        np.testing.assert_array_equal(d1, d2)
+
+
+def test_pipeline_u8_mode_matches_float_mode(native_built, tmp_path):
+    """u8 mode ships crop windows + geometry; finishing the arithmetic
+    (mean window, scale, mirror) reproduces float mode exactly."""
+    path = tmp_path / "u8.sndb"
+    _write_db(path, n=16)
+    mean = np.random.RandomState(5).rand(3, 8, 8).astype(np.float32) * 20
+    kw = dict(batch_size=4, shape=(3, 8, 8), crop=6, mirror=True,
+              train=True, seed=11, scale=0.5)
+    pf = runtime.DataPipeline(str(path), mean=mean, **kw)
+    pu = runtime.DataPipeline(str(path), mean=mean, u8_output=True, **kw)
+    for _ in range(3):
+        fdata, flabs = pf.next()
+        u8data, ulabs, h_offs, w_offs, flips = pu.next()
+        np.testing.assert_array_equal(flabs, ulabs)
+        finished = np.empty_like(fdata)
+        for i in range(4):
+            ho, wo = int(h_offs[i]), int(w_offs[i])
+            win = u8data[i].astype(np.float32) - mean[:, ho:ho+6, wo:wo+6]
+            if flips[i]:
+                win = win[:, :, ::-1]
+            finished[i] = win * 0.5
+        np.testing.assert_allclose(finished, fdata, rtol=1e-6)
+    pf.close()
+    pu.close()
+
+
+def test_pipeline_u8_fallback_matches_native(native_built, tmp_path):
+    path = tmp_path / "u8fb.sndb"
+    _write_db(path, n=12)
+    kw = dict(batch_size=6, shape=(3, 8, 8), crop=6, mirror=True,
+              train=True, seed=3, u8_output=True)
+    p_native = runtime.DataPipeline(str(path), **kw)
+    native_out = p_native.next()
+    p_native.close()
+    saved = runtime._lib
+    try:
+        runtime._lib = None
+        runtime._lib_error = "forced"
+        p_py = runtime.DataPipeline(str(path), **kw)
+        py_out = p_py.next()
+        p_py.close()
+    finally:
+        runtime._lib = saved
+        runtime._lib_error = None
+    for a, b in zip(native_out, py_out):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_finish_host_crops_on_device(native_built, tmp_path):
+    """Native u8 pipeline + device finish == native float pipeline."""
+    from sparknet_tpu.data.transforms import finish_host_crops
+
+    path = tmp_path / "fin.sndb"
+    _write_db(path, n=8)
+    mean = np.random.RandomState(8).rand(3, 8, 8).astype(np.float32) * 25
+    kw = dict(batch_size=4, shape=(3, 8, 8), crop=5, mirror=True,
+              train=True, seed=2, scale=2.0)
+    pf = runtime.DataPipeline(str(path), mean=mean, **kw)
+    pu = runtime.DataPipeline(str(path), mean=mean, u8_output=True, **kw)
+    fdata, flabs = pf.next()
+    u8data, ulabs, h_offs, w_offs, flips = pu.next()
+    pf.close()
+    pu.close()
+    fin = finish_host_crops(mean, scale=2.0)
+    out = fin({"data": u8data, "label": ulabs, "h_off": h_offs,
+               "w_off": w_offs, "flip": flips})
+    assert set(out) == {"data", "label"}
+    np.testing.assert_allclose(np.asarray(out["data"]), fdata, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(out["label"]), flabs)

@@ -325,6 +325,7 @@ def test_bfloat16_compute_keeps_f32_masters():
     assert float(losses[-1]) < 1.0
 
 
+@pytest.mark.slow
 def test_bf16_f32_train_curve_equivalence_cifar():
     """bf16-compute-with-f32-masters must track the f32 loss curve on a real
     zoo model (cifar10_full) over 200 iterations — the evidence behind
