@@ -10,121 +10,15 @@ on a pod (DCN standing in for ICI).
 """
 
 import os
-import socket
-import subprocess
-import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-_WORKER = r"""
-import os, sys
-import numpy as np
-
-pid = int(sys.argv[1])
-port = sys.argv[2]
-
-import jax
-from sparknet_tpu.parallel.mesh import initialize_distributed, make_mesh
-
-initialize_distributed(
-    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
-)
-assert jax.process_count() == 2, jax.process_count()
-assert jax.device_count() == 4, jax.device_count()
-assert jax.local_device_count() == 2
-
-import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
-
-from sparknet_tpu import config
-from sparknet_tpu.parallel import ParameterAveragingTrainer
-from sparknet_tpu.solver import Solver
-
-NET = '''
-name: "toy"
-layer { name: "data" type: "HostData" top: "x" top: "label"
-  java_data_param { shape { dim: 4 dim: 6 } shape { dim: 4 } } }
-layer { name: "ip1" type: "InnerProduct" bottom: "x" top: "logits"
-  inner_product_param { num_output: 3 weight_filler { type: "xavier" } } }
-layer { name: "loss" type: "SoftmaxWithLoss" bottom: "logits" bottom: "label" top: "loss" }
-'''
-
-sp = config.parse_solver_prototxt('base_lr: 0.05 lr_policy: "fixed" momentum: 0.9')
-solver = Solver(sp, net_param=config.parse_net_prototxt(NET))
-mesh = make_mesh({"dp": 4})
-trainer = ParameterAveragingTrainer(solver, mesh)
-
-n, tau, batch = 4, 2, 4
-sh = NamedSharding(mesh, P("dp"))
-
-def make_global(np_arr):
-    return jax.make_array_from_callback(
-        np_arr.shape, NamedSharding(mesh, P("dp")),
-        lambda idx: np_arr[idx],
+def test_two_process_averaging_round():
+    from sparknet_tpu.utils.procs import (
+        run_two_process_round,
+        toy_averaging_worker,
     )
 
-tree_map = jax.tree_util.tree_map
-st0 = solver.init_state(seed=0)
-stacked = tree_map(
-    lambda x: np.broadcast_to(np.asarray(x), (n,) + np.asarray(x).shape).copy(),
-    st0,
-)
-state = tree_map(make_global, stacked)
-
-rng = np.random.RandomState(0)  # same on both processes
-batches = {
-    "x": make_global(rng.randn(n, tau, batch, 6).astype(np.float32)),
-    "label": make_global(
-        rng.randint(0, 3, (n, tau, batch)).astype(np.float32)
-    ),
-}
-
-state, losses = trainer.round(state, batches)
-assert losses.shape == (n, tau)
-local = np.concatenate(
-    [np.asarray(s.data) for s in losses.addressable_shards], axis=0
-)
-assert np.isfinite(local).all(), local
-
-# after pmean all workers' params are identical: this process's two
-# local shards of every param leaf must agree
-for key, blobs in state.params.items():
-    for blob in blobs:
-        shards = [np.asarray(s.data) for s in blob.addressable_shards]
-        np.testing.assert_allclose(shards[0], shards[1], rtol=1e-6)
-
-print(f"MULTIHOST_OK p{pid} smoothed={solver.smoothed_loss:.4f}")
-"""
-
-
-def test_two_process_averaging_round(tmp_path):
-    script = tmp_path / "worker.py"
-    script.write_text(_WORKER)
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-
-    env = {
-        **os.environ,
-        "PYTHONPATH": _REPO,
-        "PALLAS_AXON_POOL_IPS": "",  # skip the axon TPU tunnel registration
-        "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
-    }
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(script), str(pid), str(port)],
-            env=env,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-        )
-        for pid in range(2)
-    ]
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=600)
-        outs.append(out)
-    for pid, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"process {pid} failed:\n{out}"
-        assert f"MULTIHOST_OK p{pid}" in out, out
+    run_two_process_round(
+        toy_averaging_worker("MULTIHOST_OK"), "MULTIHOST_OK", _REPO
+    )
