@@ -452,28 +452,45 @@ def bench_scaling():
 
     sweep = [n for n in (1, 2, 4, 8, 16, 32) if n <= ndev]
     results = {}
+    collective_frac = {}
     base = _host_batch(batch, model)
     for n in sweep:
-        solver = _build_solver(batch, dtype, model)
         mesh = Mesh(np.array(jax.devices()[:n]), ("dp",))
-        trainer = ParameterAveragingTrainer(solver, mesh)
-        state = trainer.init_state(seed=0)
         batches = {
             k: np.broadcast_to(v[None, None], (n, tau) + v.shape).copy()
             for k, v in base.items()
         }
-        state, losses = trainer.round(state, batches)  # compile + warm
-        jax.block_until_ready(losses)
-        t0 = time.perf_counter()
-        for _ in range(rounds):
-            state, losses = trainer.round(state, batches)
-        jax.block_until_ready(losses)
-        dt = (time.perf_counter() - t0) / rounds
+
+        def timed_round(average_params):
+            solver = _build_solver(batch, dtype, model)
+            trainer = ParameterAveragingTrainer(
+                solver, mesh, average_params=average_params
+            )
+            state = trainer.init_state(seed=0)
+            state, losses = trainer.round(state, batches)  # compile + warm
+            jax.block_until_ready(losses)
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                state, losses = trainer.round(state, batches)
+            jax.block_until_ready(losses)
+            return (time.perf_counter() - t0) / rounds
+
+        dt = timed_round(True)
         per_worker = batch * tau / dt
         results[n] = per_worker
+        # compute-vs-collective decomposition: the same round with the
+        # pmean removed is pure local compute; the difference is the
+        # collective's share of the round
+        if n > 1:
+            dt_local = timed_round(False)
+            collective_frac[n] = max(0.0, 1.0 - dt_local / dt)
         print(
-            "dp=%-2d  %8.1f img/s/worker  (%.1f img/s total)"
-            % (n, per_worker, per_worker * n),
+            "dp=%-2d  %8.1f img/s/worker  (%.1f img/s total%s)"
+            % (
+                n, per_worker, per_worker * n,
+                ", collective %.1f%% of round" % (100 * collective_frac[n])
+                if n in collective_frac else "",
+            ),
             file=sys.stderr,
         )
     eff = results[sweep[-1]] / results[1] if results.get(1) else 0.0
@@ -484,6 +501,10 @@ def bench_scaling():
         "vs_baseline": round(eff / 0.9, 3),  # target >=0.9
         "platform": jax.devices()[0].platform,
         "per_worker_img_s": {str(k): round(v, 1) for k, v in results.items()},
+        "collective_fraction_of_round": {
+            str(k): round(v, 4) for k, v in collective_frac.items()
+        },
+        "tau": tau,
     }
     if jax.devices()[0].platform == "cpu":
         # virtual devices time-share the host cores: this validates the

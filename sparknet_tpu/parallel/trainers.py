@@ -124,7 +124,12 @@ class ParameterAveragingTrainer:
         mesh: Mesh,
         axis: str = "dp",
         average_stats: bool = True,
+        average_params: bool = True,
     ):
+        """``average_params=False`` skips the cross-worker pmean — a
+        DIAGNOSTIC mode (workers then train fully independently): the
+        scaling bench A/Bs it against the real round to attribute round
+        time to compute vs collective."""
         self.solver = solver
         self.mesh = mesh
         self.axis = axis
@@ -138,10 +143,14 @@ class ParameterAveragingTrainer:
             lrng = jax.random.fold_in(rng, widx)
             st, losses = solver._step_tau(st, bt, lrng)
             # averaging round: params (and BN stats) only, never history
-            avg_params = tree_map(lambda w: jax.lax.pmean(w, axis), st.params)
+            avg_params = (
+                tree_map(lambda w: jax.lax.pmean(w, axis), st.params)
+                if average_params
+                else st.params
+            )
             avg_stats = (
                 tree_map(lambda w: jax.lax.pmean(w, axis), st.stats)
-                if average_stats
+                if average_stats and average_params
                 else st.stats
             )
             st = TrainState(avg_params, avg_stats, st.history, st.iter)

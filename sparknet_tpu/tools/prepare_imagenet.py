@@ -1,0 +1,248 @@
+"""Dataset preparation — the ``put_imagenet_on_s3.py`` role.
+
+Reference: ``scripts/put_imagenet_on_s3.py:1-116`` — split the label
+file into shuffled chunks, resize every JPEG, re-tar the chunks as
+``train.XXXXX.tar`` / ``val.XXX.tar``, upload together with
+``train.txt``/``val.txt``.  This tool produces exactly the layout the
+read side consumes (``data/object_store.py ImageNetLoader`` +
+SETUP.md §3): shards + label files + an ``index.txt`` manifest (the
+listing used by plain-HTTP roots), written locally and optionally
+synced to a bucket with ``gsutil``/``aws`` (``--dry-run`` prints the
+exact command instead).
+
+Inputs, either form per split:
+
+- ``--train_dir DIR``: a ``<class>/<image>`` tree (labels derived from
+  sorted class-folder order, or supplied via ``--train_labels``);
+- ``--train_tar FILE``: the ILSVRC-style nested tar (a tar of per-class
+  sub-tars), as the reference consumed.
+
+Chunking matches the reference: shuffle the label lines once (seeded),
+deal them round-robin into N chunks, one output shard per chunk.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import os
+import random
+import shlex
+import subprocess
+import sys
+import tarfile
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+
+def split_label_lines(
+    pairs: List[Tuple[str, int]], num_chunks: int, seed: int = 0
+) -> List[List[Tuple[str, int]]]:
+    """Shuffle once, deal round-robin (put_imagenet_on_s3.py
+    split_label_file)."""
+    pairs = list(pairs)
+    random.Random(seed).shuffle(pairs)
+    chunks: List[List[Tuple[str, int]]] = [[] for _ in range(num_chunks)]
+    for i, p in enumerate(pairs):
+        chunks[i % num_chunks].append(p)
+    return [c for c in chunks if c]
+
+
+def resize_jpeg(data: bytes, size: Optional[Tuple[int, int]]) -> bytes:
+    """Decode/resize/re-encode one image (ANTIALIAS resize + JPEG
+    re-save, like resize_and_add_image).  ``size=None`` passes the
+    original bytes through untouched — no decode cost and no
+    re-encode generation loss for a byte-identity operation."""
+    if size is None:
+        return data
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(data)).convert("RGB")
+    img = img.resize(size, Image.LANCZOS)
+    out = io.BytesIO()
+    img.save(out, format="JPEG")
+    return out.getvalue()
+
+
+def labels_from_dir(root: str) -> List[Tuple[str, int]]:
+    """``<class>/<image>`` tree -> (relative name, label) with labels
+    assigned by sorted class-folder order (the caffe_ilsvrc12 synset
+    ordering convention)."""
+    classes = sorted(
+        d for d in os.listdir(root)
+        if os.path.isdir(os.path.join(root, d))
+    )
+    pairs = []
+    for label, cls in enumerate(classes):
+        for name in sorted(os.listdir(os.path.join(root, cls))):
+            pairs.append((f"{cls}/{name}", label))
+    return pairs
+
+
+def read_label_file(path: str) -> List[Tuple[str, int]]:
+    pairs = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                name, label = line.rsplit(None, 1)
+                pairs.append((name, int(label)))
+    return pairs
+
+
+def dir_image_reader(root: str) -> Callable[[str], bytes]:
+    def read(name: str) -> bytes:
+        with open(os.path.join(root, name), "rb") as f:
+            return f.read()
+
+    return read
+
+
+def nested_tar_reader(path: str) -> Callable[[str], bytes]:
+    """Index an ILSVRC-style tar-of-subtars so members are fetched by
+    ``<subtar-stem>/<image>`` (build_index analog): only TarInfo
+    member records are cached — bytes are re-read from disk on demand
+    through kept-open handles, so memory stays flat across the real
+    138 GB train tar (the reference keeps ``filehandles`` the same
+    way)."""
+    outer = tarfile.open(path)
+    index: Dict[str, Tuple[tarfile.TarFile, tarfile.TarInfo]] = {}
+    by_basename: Dict[str, str] = {}
+    for member in outer.getmembers():
+        stem = os.path.splitext(os.path.basename(member.name))[0]
+        # extractfile gives a seekable view over the (uncompressed)
+        # outer tar, so the sub TarFile can random-access members later
+        sub = tarfile.open(fileobj=outer.extractfile(member))
+        for m in sub.getmembers():
+            key = f"{stem}/{m.name}"
+            index[key] = (sub, m)
+            by_basename[os.path.basename(m.name)] = key
+
+    def read(name: str) -> bytes:
+        entry = index.get(name)
+        if entry is None:
+            # reference train.txt keys are sometimes bare file names
+            key = by_basename.get(os.path.basename(name))
+            if key is None:
+                raise KeyError(name)
+            entry = index[key]
+        sub, m = entry
+        return sub.extractfile(m).read()
+
+    return read
+
+
+def write_shards(
+    out_dir: str,
+    prefix: str,
+    chunks: Iterable[List[Tuple[str, int]]],
+    read_image: Callable[[str], bytes],
+    size: Optional[Tuple[int, int]],
+    zfill: int,
+) -> List[str]:
+    written = []
+    for i, chunk in enumerate(chunks):
+        shard = f"{prefix}.{str(i).zfill(zfill)}.tar"
+        with tarfile.open(os.path.join(out_dir, shard), "w") as tf:
+            for name, _label in chunk:
+                data = resize_jpeg(read_image(name), size)
+                info = tarfile.TarInfo(name=name)
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+        written.append(shard)
+    return written
+
+
+def upload_command(out_dir: str, dest: str) -> List[str]:
+    """The sync command for a bucket destination (the upload_file role;
+    gsutil for gs://, aws for s3://)."""
+    if dest.startswith("gs://"):
+        return ["gsutil", "-m", "rsync", "-r", out_dir, dest]
+    if dest.startswith("s3://"):
+        return ["aws", "s3", "sync", out_dir, dest]
+    raise ValueError(f"unsupported destination {dest!r} (gs:// or s3://)")
+
+
+def _prepare_split(
+    split: str, src_dir, src_tar, labels_path, out_dir, num_chunks,
+    size, seed, zfill,
+) -> List[str]:
+    if src_dir:
+        pairs = (
+            read_label_file(labels_path) if labels_path
+            else labels_from_dir(src_dir)
+        )
+        reader = dir_image_reader(src_dir)
+    else:
+        if not labels_path:
+            raise SystemExit(
+                f"--{split}_labels is required with --{split}_tar "
+                "(nested tars carry no label information)"
+            )
+        pairs = read_label_file(labels_path)
+        reader = nested_tar_reader(src_tar)
+    with open(os.path.join(out_dir, f"{split}.txt"), "w") as f:
+        for name, label in pairs:
+            f.write(f"{name} {label}\n")
+    chunks = split_label_lines(pairs, num_chunks, seed)
+    shards = write_shards(
+        out_dir, split, chunks, reader, size, zfill
+    )
+    print(f"{split}: {len(pairs)} images -> {len(shards)} shards")
+    return shards + [f"{split}.txt"]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    p.add_argument("out_dir")
+    p.add_argument("--train_dir")
+    p.add_argument("--train_tar")
+    p.add_argument("--train_labels")
+    p.add_argument("--val_dir")
+    p.add_argument("--val_tar")
+    p.add_argument("--val_labels")
+    p.add_argument("--num_train_chunks", type=int, default=1000)
+    p.add_argument("--num_val_chunks", type=int, default=50)
+    p.add_argument("--resize", type=int, nargs=2, metavar=("W", "H"),
+                   default=None, help="resize every image to WxH (the "
+                   "reference default workflow uses 256 256)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--upload", default=None,
+                   help="gs://bucket/path or s3://bucket/path")
+    p.add_argument("--dry-run", dest="dry_run", action="store_true",
+                   help="with --upload: print the sync command only")
+    args = p.parse_args(argv)
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    size = tuple(args.resize) if args.resize else None
+    files: List[str] = []
+    if args.train_dir or args.train_tar:
+        files += _prepare_split(
+            "train", args.train_dir, args.train_tar, args.train_labels,
+            args.out_dir, args.num_train_chunks, size, args.seed, 5,
+        )
+    if args.val_dir or args.val_tar:
+        files += _prepare_split(
+            "val", args.val_dir, args.val_tar, args.val_labels,
+            args.out_dir, args.num_val_chunks, size, args.seed + 1, 3,
+        )
+    if not files:
+        print("nothing to do: give --train_dir/--train_tar and/or "
+              "--val_dir/--val_tar", file=sys.stderr)
+        return 2
+    # manifest for plain-HTTP roots (object_store.py lists index.txt)
+    with open(os.path.join(args.out_dir, "index.txt"), "w") as f:
+        for name in sorted(files):
+            f.write(name + "\n")
+
+    if args.upload:
+        cmd = upload_command(args.out_dir, args.upload)
+        if args.dry_run:
+            print(shlex.join(cmd))
+            return 0
+        print("+ " + shlex.join(cmd), file=sys.stderr)
+        return subprocess.call(cmd)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
