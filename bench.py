@@ -510,7 +510,14 @@ def bench_scaling():
         # virtual devices time-share the host cores: this validates the
         # sweep mechanics (shard_map compiles/executes at every dp), not
         # real scaling — that needs a slice (BENCH_SCALING_REAL=1)
-        out["note"] = "virtual CPU mesh: mechanics only, not real scaling"
+        out["note"] = (
+            "virtual CPU mesh: per-worker throughput is mechanics-only "
+            "(virtual devices time-share the host cores, so total img/s "
+            "plateaus at the cores' rate); collective_fraction_of_round "
+            "is the measured pmean share from the average_params=False "
+            "A/B — see PERF.md 'Scaling credibility' for the paper-model "
+            "projection onto real ICI"
+        )
     print(json.dumps(out))
 
 

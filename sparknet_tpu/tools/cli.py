@@ -378,23 +378,17 @@ def cmd_classify(args) -> int:
             net, params, stats, caffemodel.load_weights(args.weights)
         )
 
-    mean = None
-    if args.mean:
-        if os.path.isfile(args.mean):
-            mean = np.asarray(caffemodel.load_mean_image(args.mean))
-            if mean.ndim == 4:
-                mean = mean[0]
-            if mean.shape[1] < h or mean.shape[2] < w:
-                print(
-                    f"classify: mean image {mean.shape[1]}x{mean.shape[2]} "
-                    f"is smaller than the net input {h}x{w}",
-                    file=sys.stderr,
-                )
-                return 1
-        else:
-            mean = np.asarray(
-                [float(v) for v in args.mean.split(",")], np.float32
-            ).reshape(-1, 1, 1)
+    mean = _load_mean_arg(args.mean) if args.mean else None
+    if mean is not None:
+        if mean.ndim == 1:
+            mean = mean.reshape(-1, 1, 1)
+        elif mean.shape[1] < h or mean.shape[2] < w:
+            print(
+                f"classify: mean image {mean.shape[1]}x{mean.shape[2]} "
+                f"is smaller than the net input {h}x{w}",
+                file=sys.stderr,
+            )
+            return 1
     labels = None
     if args.labels:
         with open(args.labels) as f:
@@ -502,6 +496,73 @@ def cmd_upgrade_solver_proto_text(args) -> int:
     with open(args.output, "w") as f:
         f.write(prototext.dumps(sp))
     print(f"Wrote upgraded solver to {args.output}")
+    return 0
+
+
+def _load_mean_arg(arg: str):
+    """``--mean`` value -> array: a mean.binaryproto path gives the
+    (C, H, W) mean image; comma-separated values give per-channel (C,)
+    means.  Shared by ``classify`` and ``detect``."""
+    import os
+
+    import numpy as np
+
+    from sparknet_tpu.io import caffemodel
+
+    if os.path.isfile(arg):
+        mean = np.asarray(caffemodel.load_mean_image(arg))
+        return mean[0] if mean.ndim == 4 else mean
+    return np.asarray([float(v) for v in arg.split(",")], np.float32)
+
+
+def cmd_detect(args) -> int:
+    """``detect --model M [--weights W] --window_file F`` — R-CNN-style
+    windowed detection: score every proposal window listed in an R-CNN
+    window file (reference: ``python/caffe/detector.py`` driven over
+    ``window_data_layer``-format files).  Prints one line per window:
+    ``<image> <x1> <y1> <x2> <y2> <top-class> <score>``."""
+    import numpy as np
+
+    from sparknet_tpu import config, models
+    from sparknet_tpu.data.windows import parse_window_file
+    from sparknet_tpu.tools.detector import Detector
+
+    netp = (
+        config.load_net_prototxt(args.model)
+        if args.model.endswith(".prototxt")
+        else models.load_model(args.model)
+    )
+    mean = _load_mean_arg(args.mean) if args.mean else None
+    # Detector validates a too-small mean image itself
+    det = Detector(
+        netp,
+        weights=args.weights,
+        mean=mean,
+        context_pad=args.context_pad,
+        crop_mode=args.crop_mode,
+        batch=args.batch,
+    )
+    images = parse_window_file(args.window_file, args.root_folder)
+    jobs = []
+    for im in images:
+        # window-file rows are (class, overlap, x1, y1, x2, y2),
+        # inclusive; Detector takes (ymin, xmin, ymax, xmax) max-exclusive
+        wins = [
+            (int(y1), int(x1), int(y2) + 1, int(x2) + 1)
+            for (_cls, _ov, x1, y1, x2, y2) in im.windows
+        ]
+        if wins:
+            jobs.append((im.path, wins))
+    dets = det.detect_windows(jobs)
+    for d in dets:
+        ymin, xmin, ymax, xmax = [int(v) for v in d["window"]]
+        top = int(np.argmax(d["prediction"]))
+        print(
+            f"{d['filename']} {xmin} {ymin} {xmax - 1} {ymax - 1} "
+            f"{top} {float(d['prediction'][top]):.4f}"
+        )
+    print(f"scored {len(dets)} windows over {len(jobs)} images",
+          file=sys.stderr)
     return 0
 
 
@@ -668,6 +729,20 @@ def main(argv=None) -> int:
         p.add_argument("input")
         p.add_argument("output")
         p.set_defaults(fn=fn)
+
+    p = sub.add_parser("detect")
+    p.add_argument("--model", required=True,
+                   help="deploy prototxt or zoo model name")
+    p.add_argument("--weights", default=None)
+    p.add_argument("--window_file", required=True,
+                   help="R-CNN window_data file of proposal windows")
+    p.add_argument("--root_folder", default="")
+    p.add_argument("--mean", default=None,
+                   help="mean.binaryproto path or comma-separated values")
+    p.add_argument("--context_pad", type=int, default=0)
+    p.add_argument("--crop_mode", default="warp", choices=["warp", "square"])
+    p.add_argument("--batch", type=int, default=32)
+    p.set_defaults(fn=cmd_detect)
 
     p = sub.add_parser("draw_net")
     p.add_argument("input")

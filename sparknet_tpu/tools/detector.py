@@ -81,6 +81,14 @@ class Detector:
                 net, self.params, self.stats, caffemodel.load_weights(weights)
             )
         self.mean = None if mean is None else np.asarray(mean, np.float32)
+        if self.mean is not None and self.mean.ndim == 3 and (
+            self.mean.shape[1] < self.crop_h
+            or self.mean.shape[2] < self.crop_w
+        ):
+            raise ValueError(
+                f"mean image {self.mean.shape[1]}x{self.mean.shape[2]} is "
+                f"smaller than the net input {self.crop_h}x{self.crop_w}"
+            )
         self.input_scale = input_scale
         self.context_pad = int(context_pad)
         self.crop_mode = crop_mode

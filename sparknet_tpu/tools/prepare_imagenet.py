@@ -108,6 +108,10 @@ def nested_tar_reader(path: str) -> Callable[[str], bytes]:
     index: Dict[str, Tuple[tarfile.TarFile, tarfile.TarInfo]] = {}
     by_basename: Dict[str, str] = {}
     for member in outer.getmembers():
+        # real tars carry directory entries / stray non-tar files next to
+        # the class sub-tars; only regular .tar members are sub-tars
+        if not member.isfile() or not member.name.endswith(".tar"):
+            continue
         stem = os.path.splitext(os.path.basename(member.name))[0]
         # extractfile gives a seekable view over the (uncompressed)
         # outer tar, so the sub TarFile can random-access members later
@@ -131,25 +135,62 @@ def nested_tar_reader(path: str) -> Callable[[str], bytes]:
     return read
 
 
+# reader spec -> reader, rebuilt once per worker process (closures over
+# open tar handles are not picklable)
+ReaderSpec = Tuple[str, str]  # ("dir"|"tar", path)
+_WORKER_READER: Optional[Callable[[str], bytes]] = None
+
+
+def _make_reader(spec: ReaderSpec) -> Callable[[str], bytes]:
+    kind, path = spec
+    return dir_image_reader(path) if kind == "dir" else nested_tar_reader(path)
+
+
+def _init_worker(spec: ReaderSpec) -> None:
+    global _WORKER_READER
+    _WORKER_READER = _make_reader(spec)
+
+
+def _write_one_shard(job) -> str:
+    out_path, chunk, size = job
+    with tarfile.open(out_path, "w") as tf:
+        for name, _label in chunk:
+            data = resize_jpeg(_WORKER_READER(name), size)
+            info = tarfile.TarInfo(name=name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    return os.path.basename(out_path)
+
+
 def write_shards(
     out_dir: str,
     prefix: str,
-    chunks: Iterable[List[Tuple[str, int]]],
-    read_image: Callable[[str], bytes],
+    chunks: List[List[Tuple[str, int]]],
+    reader_spec: ReaderSpec,
     size: Optional[Tuple[int, int]],
     zfill: int,
+    workers: int = 1,
 ) -> List[str]:
-    written = []
-    for i, chunk in enumerate(chunks):
-        shard = f"{prefix}.{str(i).zfill(zfill)}.tar"
-        with tarfile.open(os.path.join(out_dir, shard), "w") as tf:
-            for name, _label in chunk:
-                data = resize_jpeg(read_image(name), size)
-                info = tarfile.TarInfo(name=name)
-                info.size = len(data)
-                tf.addfile(info, io.BytesIO(data))
-        written.append(shard)
-    return written
+    """One output shard per chunk; with ``workers > 1`` chunks are
+    written by a process pool (they are independent — the decode/resize/
+    re-encode of the full 1.28M-image ImageNet is CPU-bound; each worker
+    re-opens the source via ``reader_spec``)."""
+    jobs = [
+        (
+            os.path.join(out_dir, f"{prefix}.{str(i).zfill(zfill)}.tar"),
+            chunk,
+            size,
+        )
+        for i, chunk in enumerate(chunks)
+    ]
+    if workers <= 1:
+        _init_worker(reader_spec)
+        return [_write_one_shard(j) for j in jobs]
+    import multiprocessing as mp
+
+    with mp.Pool(workers, initializer=_init_worker,
+                 initargs=(reader_spec,)) as pool:
+        return list(pool.map(_write_one_shard, jobs))
 
 
 def upload_command(out_dir: str, dest: str) -> List[str]:
@@ -164,14 +205,14 @@ def upload_command(out_dir: str, dest: str) -> List[str]:
 
 def _prepare_split(
     split: str, src_dir, src_tar, labels_path, out_dir, num_chunks,
-    size, seed, zfill,
+    size, seed, zfill, workers=1,
 ) -> List[str]:
     if src_dir:
         pairs = (
             read_label_file(labels_path) if labels_path
             else labels_from_dir(src_dir)
         )
-        reader = dir_image_reader(src_dir)
+        reader_spec: ReaderSpec = ("dir", src_dir)
     else:
         if not labels_path:
             raise SystemExit(
@@ -179,13 +220,27 @@ def _prepare_split(
                 "(nested tars carry no label information)"
             )
         pairs = read_label_file(labels_path)
-        reader = nested_tar_reader(src_tar)
+        reader_spec = ("tar", src_tar)
+    # the read side keys labels by BASENAME (ImageNetLoader.scala:41-54
+    # semantics) — colliding basenames would silently corrupt labels, so
+    # the producer refuses them
+    seen: Dict[str, str] = {}
+    for name, _ in pairs:
+        base = os.path.basename(name)
+        if base in seen and seen[base] != name:
+            raise SystemExit(
+                f"{split}: duplicate image basename {base!r} "
+                f"({seen[base]!r} vs {name!r}) — the reader keys labels "
+                "by basename, so names must be globally unique "
+                "(rename, e.g. prefix the class)"
+            )
+        seen[base] = name
     with open(os.path.join(out_dir, f"{split}.txt"), "w") as f:
         for name, label in pairs:
             f.write(f"{name} {label}\n")
     chunks = split_label_lines(pairs, num_chunks, seed)
     shards = write_shards(
-        out_dir, split, chunks, reader, size, zfill
+        out_dir, split, chunks, reader_spec, size, zfill, workers=workers
     )
     print(f"{split}: {len(pairs)} images -> {len(shards)} shards")
     return shards + [f"{split}.txt"]
@@ -206,6 +261,9 @@ def main(argv=None) -> int:
                    default=None, help="resize every image to WxH (the "
                    "reference default workflow uses 256 256)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--workers", type=int, default=1,
+                   help="process pool size for the decode/resize/re-tar "
+                   "stage (chunks are independent)")
     p.add_argument("--upload", default=None,
                    help="gs://bucket/path or s3://bucket/path")
     p.add_argument("--dry-run", dest="dry_run", action="store_true",
@@ -219,11 +277,13 @@ def main(argv=None) -> int:
         files += _prepare_split(
             "train", args.train_dir, args.train_tar, args.train_labels,
             args.out_dir, args.num_train_chunks, size, args.seed, 5,
+            workers=args.workers,
         )
     if args.val_dir or args.val_tar:
         files += _prepare_split(
             "val", args.val_dir, args.val_tar, args.val_labels,
             args.out_dir, args.num_val_chunks, size, args.seed + 1, 3,
+            workers=args.workers,
         )
     if not files:
         print("nothing to do: give --train_dir/--train_tar and/or "

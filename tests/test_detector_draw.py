@@ -1,6 +1,8 @@
 """pycaffe long-tail parity: net visualization (draw.py analog) and the
 windowed-detection driver (detector.py analog)."""
 
+import os
+
 import numpy as np
 
 from sparknet_tpu import config, models
@@ -155,3 +157,43 @@ def test_detector_derives_deploy_view():
     for d in dets:
         assert d["prediction"].shape == (10,)
         np.testing.assert_allclose(d["prediction"].sum(), 1.0, rtol=1e-4)
+
+
+def test_detect_cli(tmp_path):
+    """`cli detect` scores every window of an R-CNN window file through
+    the Detector (the detector.py-over-window_data workflow)."""
+    import subprocess
+    import sys
+
+    from PIL import Image
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    im = _red_blue_image()
+    img_path = tmp_path / "im0.png"
+    Image.fromarray(im).save(img_path)
+    # window-file rows: class overlap x1 y1 x2 y2 (inclusive)
+    wf = tmp_path / "windows.txt"
+    wf.write_text(
+        f"# 0\n{img_path}\n3\n16\n16\n2\n"
+        "1 0.9 0 0 7 15\n"   # left half: red
+        "2 0.9 8 0 15 15\n"  # right half: blue
+    )
+    deploy = tmp_path / "deploy.prototxt"
+    deploy.write_text(DEPLOY)
+
+    out = subprocess.run(
+        [sys.executable, "-m", "sparknet_tpu.tools.cli", "detect",
+         "--model", str(deploy), "--window_file", str(wf), "--batch", "2"],
+        env={**os.environ, "PYTHONPATH": repo, "JAX_PLATFORMS": "cpu",
+             "PALLAS_AXON_POOL_IPS": ""},
+        capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    lines = out.stdout.strip().splitlines()
+    assert len(lines) == 2
+    # "<image> <x1> <y1> <x2> <y2> <class> <score>" with the original
+    # inclusive coordinates echoed back
+    p0 = lines[0].split()
+    assert p0[0] == str(img_path)
+    assert p0[1:5] == ["0", "0", "7", "15"]
+    assert "scored 2 windows over 1 images" in out.stderr

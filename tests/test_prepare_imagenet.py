@@ -143,3 +143,40 @@ def test_upload_dry_run(tmp_path):
     )
     with pytest.raises(ValueError, match="unsupported"):
         prep.upload_command(str(out), "ftp://x")
+
+
+def test_worker_pool_writes_identical_shards(tmp_path):
+    src = tmp_path / "raw"
+    _make_class_tree(src, classes=2, per_class=4)
+    outs = {}
+    for w in (1, 2):
+        out = tmp_path / f"out_w{w}"
+        rc = prep.main([
+            str(out), "--train_dir", str(src), "--num_train_chunks", "3",
+            "--resize", "24", "24", "--workers", str(w),
+        ])
+        assert rc == 0
+        outs[w] = {
+            p: (out / p).read_bytes()
+            for p in sorted(os.listdir(out))
+        }
+    assert outs[1].keys() == outs[2].keys()
+    for name in outs[1]:
+        assert outs[1][name] == outs[2][name], name
+
+
+def test_duplicate_basenames_refused(tmp_path):
+    src = tmp_path / "raw"
+    rng = np.random.RandomState(0)
+    for c in range(2):
+        d = src / f"cls{c}"
+        d.mkdir(parents=True)
+        # SAME basename in both classes: reader keys labels by basename
+        Image.fromarray(
+            rng.randint(0, 256, (16, 16, 3), np.uint8)
+        ).save(d / "0001.JPEG")
+    with pytest.raises(SystemExit, match="duplicate image basename"):
+        prep.main([
+            str(tmp_path / "out"), "--train_dir", str(src),
+            "--num_train_chunks", "1",
+        ])
