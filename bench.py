@@ -295,7 +295,10 @@ def bench_hostfeed():
     tau = int(os.environ.get("BENCH_TAU", "4"))
     rounds = int(os.environ.get("BENCH_ROUNDS", "3"))
     hostcrop = os.environ.get("BENCH_HOSTCROP", "1") != "0"
-    full, crop = 256, 227
+    # stored-record and crop geometry; override for small-model smokes
+    # (e.g. cifar10_full: BENCH_FULL=32 BENCH_CROP=28)
+    full = int(os.environ.get("BENCH_FULL", "256"))
+    crop = int(os.environ.get("BENCH_CROP", "227"))
 
     netp = replace_data_layers(
         models.load_model(model),

@@ -1,0 +1,65 @@
+"""bench.py CI smokes: every recorded-artifact mode must run end to end
+on CPU with tiny shapes and emit its one-line JSON contract (the driver
+runs these same entry points on the real chip)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_bench(env_extra, timeout=900):
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py")],
+        env={
+            # drop any stray BENCH_* from the developer's shell so the
+            # subprocess env is fully determined by the test
+            **{k: v for k, v in os.environ.items()
+               if not k.startswith("BENCH_")},
+            "PYTHONPATH": _REPO,
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",
+            "BENCH_MODE": "train",
+            **env_extra,
+        },
+        capture_output=True, text=True, timeout=timeout,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    line = out.stdout.strip().splitlines()[-1]
+    rec = json.loads(line)
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec, rec
+    return rec
+
+
+@pytest.mark.slow
+def test_train_mode_smoke():
+    rec = _run_bench({
+        "BENCH_MODEL": "cifar10_full", "BENCH_BATCH": "8",
+        "BENCH_ITERS": "2", "BENCH_WINDOWS": "2", "BENCH_PASSES": "2",
+    })
+    assert rec["metric"] == "cifar10_full_train_images_per_sec"
+    assert rec["value"] > 0
+    assert len(rec["passes_img_s"]) == 2
+    assert rec["median_img_s"] <= rec["value"]  # headline is best-of-N
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("hostcrop", ["1", "0"])
+def test_hostfeed_mode_smoke(hostcrop):
+    rec = _run_bench({
+        "BENCH_MODE": "hostfeed", "BENCH_MODEL": "cifar10_full",
+        "BENCH_BATCH": "16", "BENCH_TAU": "2", "BENCH_ROUNDS": "2",
+        "BENCH_FULL": "32", "BENCH_CROP": "28",
+        "BENCH_HOSTCROP": hostcrop,
+    })
+    assert rec["metric"] == "cifar10_full_hostfeed_images_per_sec"
+    assert rec["value"] > 0
+    assert rec["host_pipeline_images_per_sec"] > 0
+    assert rec["mode"] == (
+        "u8_hostcrop" if hostcrop == "1" else "u8_fullframe_devicecrop"
+    )
