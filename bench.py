@@ -54,6 +54,12 @@ if _MODE == "scaling":
 
 BASELINE_IMG_S = 5120.0 / 19.2  # reference K40+cuDNN (CaffeNet protocol)
 
+# put-latency idleness probe (shared with tools/link_probe.py): a put of
+# PROBE_BYTES lands in ~4 ms against an idle device queue and 0.1-1 s
+# against a busy one on the axon relay (PERF.md)
+PROBE_BYTES = 4 << 20
+PROBE_IDLE_S = 0.025
+
 # per-model reference rates (same K40+cuDNN hardware table)
 _MODEL_BASELINE_IMG_S = {
     "alexnet": BASELINE_IMG_S,
@@ -265,7 +271,7 @@ def bench_train():
 
 
 def bench_hostfeed():
-    """Full-path throughput: record DB -> native pipeline -> staged
+    """Full-path throughput: record DB -> native pipeline -> overlapped
     host->device transfer -> training step — the CallbackBenchmarkSpec
     analog (the reference measured its JNA callback feed the same way;
     BASELINE.md).
@@ -274,9 +280,26 @@ def bench_hostfeed():
     on the host (uint8 row copies, 5.2x fewer bytes over the link than
     float full-frames) and the mean/scale/mirror arithmetic fuses into
     the jitted step (``finish_host_crops``).  BENCH_HOSTCROP=0 A/Bs the
-    full-frame path with on-device cropping.  Transfers are staged
-    strictly BETWEEN steps: on the remote-TPU tunnel a device_put that
-    overlaps an execute collapses to ~1/50th bandwidth (PERF.md).
+    full-frame path with on-device cropping.
+
+    Transfer discipline (PERF.md "Relay transfer degradation"): the timed
+    loop performs NO device->host transfer — each round device_puts the
+    next host batch (the put overlaps the still-draining previous step:
+    dispatch is async) and dispatches the step via the plain jit call
+    (an AOT ``lower().compile()`` executable pays a catastrophic
+    first-execute penalty on this relay; the jit path does not, beyond
+    the shared once-per-program warm cost).  Synchronization never uses
+    the device->host lane inside the region: ``block_until_ready`` /
+    ``is_ready`` report early through this relay, and ANY device_get
+    permanently collapses later puts ~200x, so idleness is detected by
+    timing a small device_put probe (fast only when the device queue is
+    empty).  The warm window drains the same way before the clock
+    starts; the loss fetch that verifies the run happens after the
+    clock stops.  This is the prefetch + async H2D overlap the
+    reference gets from base_data_layer.cpp:70-101, expressed as XLA
+    async dispatch.  A legacy synced regime (device_get every round, as
+    round 4 measured) is re-measured afterwards in the then-degraded
+    link mode and reported as ``ab_synced_img_s``.
     """
     import tempfile
 
@@ -292,8 +315,8 @@ def bench_hostfeed():
 
     model = os.environ.get("BENCH_MODEL", "caffenet")
     batch = int(os.environ.get("BENCH_BATCH", "256"))
-    tau = int(os.environ.get("BENCH_TAU", "4"))
-    rounds = int(os.environ.get("BENCH_ROUNDS", "3"))
+    tau = int(os.environ.get("BENCH_TAU", "8"))
+    rounds = int(os.environ.get("BENCH_ROUNDS", "8"))
     hostcrop = os.environ.get("BENCH_HOSTCROP", "1") != "0"
     # stored-record and crop geometry; override for small-model smokes
     # (e.g. cifar10_full: BENCH_FULL=32 BENCH_CROP=28)
@@ -349,27 +372,84 @@ def bench_hostfeed():
             out["flip"] = np.stack([p[4] for p in parts])
         return out
 
-    # producer thread makes HOST batches only; the device_put is staged
-    # on the consumer between steps (tunnel discipline)
+    # producer thread makes HOST batches only; the consumer device_puts
+    # each batch and dispatches the step — all asynchronous, zero
+    # device->host traffic inside the timed region
     pf = Prefetcher(produce, device_put=False)
 
-    def stage_and_step(state):
-        hb = next(pf)
-        db = jax.device_put(hb)
-        jax.block_until_ready(db["data"])
-        state, losses = solver.step(state, db)
-        return state, losses
+    from sparknet_tpu.utils.rngs import train_key
 
-    state, losses = stage_and_step(state)  # compile + warm
-    jax.block_until_ready(losses)
+    rng0 = train_key(0)
+
+    probe_buf = np.random.randint(0, 256, PROBE_BYTES, dtype=np.uint8)
+
+    def probe_put():
+        """Seconds for a small put — ~4 ms when the device queue is
+        empty, 0.1-1 s while work is in flight.  The only trustworthy
+        no-D2H idleness signal on the axon relay."""
+        t = time.perf_counter()
+        jax.block_until_ready(jax.device_put(probe_buf))
+        return time.perf_counter() - t
+
+    # Sync discipline: block_until_ready FIRST (honest and sufficient on
+    # CPU and real TPU-VMs — it returns only when the queue is drained,
+    # and the probe then exits on its first fast iteration), THEN
+    # put-probe until idle (covers the axon relay, where block/is_ready
+    # report early and a healthy-looking clock would otherwise close
+    # while work is still in flight).
+    def drain_queue(losses, interval, cap):
+        jax.block_until_ready(losses)
+        t_start = time.perf_counter()
+        while time.perf_counter() - t_start < cap:
+            if probe_put() < PROBE_IDLE_S:
+                return True
+            time.sleep(interval)
+        return False
+
+    # warm window: compile + the relay's once-per-program first-execute
+    # cost (minutes for a model this size)
+    sample = next(pf)
+    state, losses = solver._jit_step(state, jax.device_put(sample), rng0)
+    warm_cap = float(os.environ.get("BENCH_WARM_CAP_S", "480"))
+    warmed = drain_queue(losses, 15.0, warm_cap)
+    print(
+        "hostfeed warmup %s" % ("drained" if warmed else "CAP HIT"),
+        file=sys.stderr,
+    )
+
     t0 = time.perf_counter()
     for _ in range(rounds):
-        state, losses = stage_and_step(state)
-    float(jnp_sum_scalar(losses))
+        db = jax.device_put(next(pf))
+        state, losses = solver._jit_step(state, db, rng0)
+    # close the clock the same way (in-order queue: last round done ==
+    # device idle); the probe itself is host->device only
+    closed = drain_queue(losses, 0.05, 600.0)
     elapsed = time.perf_counter() - t0
+    # a cap-hit means the clock closed against a still-busy queue: the
+    # number would overstate — flag it in the JSON so it can't pass as a
+    # clean measurement
+    clock_ok = bool(warmed and closed)
+    img_s = batch * tau * rounds / elapsed
+
+    # verification AFTER the clock: the first device_get in a process
+    # pays its own one-off relay penalty and flips the put lane into the
+    # ~9 MB/s degraded mode — both must stay outside the timed region
+    lv = np.asarray(jax.device_get(losses))
+    assert lv.shape == (tau,) and np.isfinite(lv).all(), lv
+
+    # legacy synced regime (round-4 protocol): device_get each round,
+    # staged puts — measured in the degraded mode the sync above left
+    # the relay in, which is exactly the regime it documents
+    t0 = time.perf_counter()
+    ab_rounds = 1
+    for _ in range(ab_rounds):
+        db = jax.device_put(next(pf))
+        jax.block_until_ready(db["data"])
+        state, losses = solver._jit_step(state, db, rng0)
+        float(np.asarray(jax.device_get(losses)).sum())
+    ab_synced_img_s = batch * tau * ab_rounds / (time.perf_counter() - t0)
     pf.stop()
     pipe.close()
-    img_s = batch * tau * rounds / elapsed
 
     # host data plane alone (no device transfer): what the host side
     # sustains independent of the host->device link, in both modes
@@ -393,12 +473,13 @@ def bench_hostfeed():
     )
     print(
         "host-feed (%s): %.1f img/s end-to-end (%.2f MB/s over the host "
-        "link); host pipeline alone: f32-transform %.1f img/s, "
-        "u8-hostcrop %.1f img/s"
+        "link); synced-per-round regime %.1f img/s; host pipeline alone: "
+        "f32-transform %.1f img/s, u8-hostcrop %.1f img/s"
         % (
             "u8 host-crop" if hostcrop else "u8 full-frame",
             img_s,
             img_s * bytes_per_img / 1e6,
+            ab_synced_img_s,
             host_rates["f32_full_transform"],
             host_rates["u8_hostcrop"],
         ),
@@ -423,8 +504,18 @@ def bench_hostfeed():
             host_rates["u8_hostcrop"], 1
         ),
         "link_mb_per_sec": round(img_s * bytes_per_img / 1e6, 1),
-        "note": "staged transfers (no put/execute overlap; see PERF.md "
-        "tunnel analysis); native pipeline, %d workers default"
+        "ab_synced_img_s": round(ab_synced_img_s, 1),
+        "images": batch * tau * rounds,
+        "clock_ok": clock_ok,
+        "note": "overlapped transfers: async put+dispatch per round, "
+        "clock opened and closed by put-latency idleness probing (no "
+        "device->host traffic inside the region: any D2H flips the axon "
+        "relay's put lane to ~9 MB/s permanently, and "
+        "block_until_ready/is_ready report early — PERF.md 'Relay "
+        "transfer degradation'); losses verified by device_get after "
+        "the clock stops; ab_synced_img_s re-runs the round-4 "
+        "device_get-per-round protocol in the degraded mode that sync "
+        "leaves behind; native pipeline, %d workers default"
         % (os.cpu_count() or 1),
     }
     print(json.dumps(out))

@@ -39,6 +39,15 @@ __all__ = [
 ]
 
 
+def _host_mean(mean):
+    """Mean image as a HOST (numpy) array.  A device-resident closure
+    constant makes jit lowering fetch its value back — a device->host
+    transfer that permanently degrades the axon relay's put lane
+    (PERF.md "Relay transfer degradation"); a numpy constant embeds as
+    an HLO literal with no device traffic."""
+    return None if mean is None else np.asarray(mean, np.float32)
+
+
 def finish_host_crops(
     mean: Optional[np.ndarray],
     scale: float = 1.0,
@@ -51,7 +60,7 @@ def finish_host_crops(
     image — data_transformer.cpp:49-58 semantics), scales, and applies
     the mirror, all fused into the training step.  The rng argument is
     ignored (randomness was drawn on the host, deterministically)."""
-    mean_arr = None if mean is None else jnp.asarray(mean, jnp.float32)
+    mean_arr = _host_mean(mean)
 
     def fn(batch: Batch, rng=None) -> Batch:
         x = batch[data_key].astype(jnp.float32)
@@ -115,7 +124,7 @@ def train_transform(
     """Random crop + mirror + mean-sub closure for TRAIN phase
     (``imageNetTrainPreprocessing``, ImageNetApp.scala:166-180; randomness
     per image, like DataTransformer's per-datum Rand())."""
-    mean_arr = None if mean is None else jnp.asarray(mean, jnp.float32)
+    mean_arr = _host_mean(mean)
 
     def fn(batch: Batch, rng: jax.Array) -> Batch:
         imgs = batch[data_key]
@@ -148,7 +157,7 @@ def test_transform(
 ) -> Callable[[Batch], Batch]:
     """Deterministic center-crop + mean-sub closure for TEST phase
     (``imageNetTestPreprocessing``, ImageNetApp.scala:128-142)."""
-    mean_arr = None if mean is None else jnp.asarray(mean, jnp.float32)
+    mean_arr = _host_mean(mean)
 
     def fn(batch: Batch) -> Batch:
         imgs = batch[data_key]

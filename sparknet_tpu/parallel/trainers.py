@@ -218,12 +218,10 @@ class ParameterAveragingTrainer:
         — worker-major, tau-deep.  Returns (state, losses (workers, tau))."""
         rng = rng if rng is not None else train_key(0)
         state, losses = self._round(state, batches, rng)
-        # smoothed-loss window from the ADDRESSABLE shards only — in a
-        # multi-host run each process sees its own workers (the reference
-        # driver likewise logs from what reaches it)
-        shards = [np.asarray(s.data) for s in losses.addressable_shards]
-        for l in np.mean(np.concatenate(shards, axis=0), axis=0):
-            self.solver._loss_window.append(float(l))
+        # recorded lazily: smoothed_loss pulls the worker-mean of the
+        # addressable shards on read (Solver._drain_losses) — no
+        # device->host sync in the round loop
+        self.solver.note_losses(losses)
         return state, losses
 
     def test_and_store_result(
@@ -356,6 +354,5 @@ class AllReduceTrainer:
         rng = rng if rng is not None else train_key(0)
         batches = jax.device_put(batches, self._batch_sharding)
         state, losses = self._jit_round(state, batches, rng)
-        for l in list(jax.device_get(losses)):
-            self.solver._loss_window.append(float(l))
+        self.solver.note_losses(losses)
         return state, losses

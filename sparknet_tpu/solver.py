@@ -192,6 +192,13 @@ class Solver:
         self._test_net: Optional[JaxNet] = None
         self._lr_mults, self._decay_mults = self.net.param_multipliers()
         self._loss_window = collections.deque(maxlen=max(1, param.average_loss))
+        # per-tau-window loss arrays not yet pulled to host: smoothed_loss
+        # materializes them on read.  Keeping the hot loop free of
+        # device->host syncs is standard TPU async-dispatch discipline,
+        # and on the axon relay it is load-bearing: ANY device_get
+        # permanently degrades later host->device puts ~200x (PERF.md
+        # "Relay transfer degradation").
+        self._pending_losses: list = []
         self._jit_step = jax.jit(self._step_tau, donate_argnums=(0,))
         self._jit_forward_test = jax.jit(self._forward_test)
 
@@ -339,8 +346,7 @@ class Solver:
                 self._step_repeat, donate_argnums=(0,), static_argnums=(3,)
             )
         state, losses = self._jit_step_repeat(state, batch, rng, tau)
-        for l in list(jax.device_get(losses)):
-            self._loss_window.append(float(l))
+        self.note_losses(losses)
         return state, losses
 
     def step(
@@ -354,9 +360,43 @@ class Solver:
             first = jax.tree_util.tree_map(lambda x: x[0], batches)
             self.debug_info_pass(state, first, rng=rng)
         state, losses = self._jit_step(state, batches, rng)
-        for l in list(jax.device_get(losses)):
-            self._loss_window.append(float(l))
+        self.note_losses(losses)
         return state, losses
+
+    def note_losses(self, losses) -> None:
+        """Record a tau-window's per-iter losses for ``smoothed_loss``
+        WITHOUT a device->host transfer (that sync happens lazily when
+        smoothed_loss is read — solver.cpp:225-234 computes the window
+        eagerly, but it runs on-host; here the fetch would serialize the
+        async dispatch queue and, through the axon relay, degrade the
+        host->device feed permanently — PERF.md)."""
+        self._pending_losses.append(losses)
+        # the window needs at most its last ``maxlen`` values and every
+        # pending array carries >=1, so older arrays can never reach it
+        # — drop them (bounds device-buffer retention when the caller
+        # never reads smoothed_loss)
+        excess = len(self._pending_losses) - self._loss_window.maxlen
+        if excess > 0:
+            del self._pending_losses[:excess]
+
+    def _drain_losses(self) -> None:
+        if not self._pending_losses:
+            return
+        import numpy as np
+
+        pending, self._pending_losses = self._pending_losses, []
+        for arr in pending:
+            if getattr(arr, "ndim", 0) == 2:
+                # trainer rounds: (workers, tau) — window sees the
+                # worker-mean of the ADDRESSABLE shards only (a
+                # multi-host process logs from what reaches it, like the
+                # reference driver)
+                shards = [np.asarray(s.data) for s in arr.addressable_shards]
+                vals = np.mean(np.concatenate(shards, axis=0), axis=0)
+            else:
+                vals = np.asarray(jax.device_get(arr)).reshape(-1)
+            for l in vals:
+                self._loss_window.append(float(l))
 
     # ------------------------------------------------------------------
     # debug_info (reference: net.cpp:648-735, gated by
@@ -433,7 +473,10 @@ class Solver:
 
     @property
     def smoothed_loss(self) -> float:
-        """Windowed average (``average_loss``, solver.cpp:225-234)."""
+        """Windowed average (``average_loss``, solver.cpp:225-234).
+        Reading this is the device->host sync point for pending loss
+        arrays (see ``note_losses``)."""
+        self._drain_losses()
         if not self._loss_window:
             return float("nan")
         return sum(self._loss_window) / len(self._loss_window)

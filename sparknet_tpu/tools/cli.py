@@ -153,7 +153,11 @@ def cmd_train(args) -> int:
     # thread so the train loop keeps stepping (Orbax-style async
     # checkpointing; the snapshot itself still publishes atomically)
     ckpt = checkpoint.AsyncCheckpointer() if args.async_snapshot else None
-    while int(jax.device_get(state.iter)) < max_iter:
+    # iter tracked host-side: it advances exactly tau per window, and a
+    # per-round device_get of state.iter would sync the async dispatch
+    # queue (and degrade the put lane on the axon relay — PERF.md)
+    it = int(jax.device_get(state.iter))
+    while it < max_iter:
         batches = (
             sampler.next_window()
             if sampler
@@ -163,8 +167,13 @@ def cmd_train(args) -> int:
             state, _ = trainer.step(state, batches)
         else:
             state, _ = solver.step(state, batches)
-        it = int(jax.device_get(state.iter))
-        log.log(f"iter {it} smoothed_loss {solver.smoothed_loss:.4f}")
+        it += args.tau
+        # throttled logging (SolverParameter.display semantics,
+        # solver.cpp:237): reading smoothed_loss is the device sync
+        # point, so it runs once per display interval, not per window
+        disp = solver_param.display or args.tau
+        if it % disp < args.tau:
+            log.log(f"iter {it} smoothed_loss {solver.smoothed_loss:.4f}")
         action = handler.get_action()
         if action == SolverAction.SNAPSHOT or (
             snap_every and it % snap_every < args.tau and it >= snap_every

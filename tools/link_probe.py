@@ -1,0 +1,148 @@
+"""Bisect harness for the axon relay's transfer-lane behavior (PERF.md
+"Relay transfer degradation", rewritten in round 5).
+
+Findings this reproduces (each mode is meant for a FRESH process —
+degraded state is sticky):
+
+  sizes     put-size -> bandwidth curve, before/after the trigger
+  execute   executes (conv/grad/scan/donation/RBG) do NOT degrade puts
+  d2h       ANY device->host transfer (even 16 B) degrades later puts
+            ~200x, permanently
+  closure   jit of a fn closing over a DEVICE array degrades too (the
+            lowering fetches the constant = hidden D2H) while a numpy
+            closure constant is free
+  firstexec first execution of a program pays a deferred one-off cost
+            (minutes for big programs) during which block_until_ready /
+            is_ready report early; put-latency probing detects the true
+            drain point
+
+Usage: python tools/link_probe.py {sizes|execute|d2h|closure|firstexec}
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def put_rate(nbytes=16 << 20, reps=3):
+    ts = []
+    for _ in range(reps):
+        host = np.random.randint(0, 256, nbytes, dtype=np.uint8)
+        t0 = time.perf_counter()
+        d = jax.device_put(host)
+        jax.block_until_ready(d)
+        ts.append(time.perf_counter() - t0)
+        del d
+    return nbytes / min(ts) / 1e6
+
+
+def report(label):
+    print("%-38s %8.1f MB/s" % (label, put_rate()))
+
+
+def mode_sizes():
+    report("fresh 16MB")
+    x = jax.device_put(np.zeros(4, np.float32))
+    _ = jax.device_get(x)  # the trigger
+    for kb in (8, 256, 1024, 4096, 16384, 65536):
+        print("degraded %8d KB: %8.1f MB/s" % (kb, put_rate(kb << 10)))
+
+
+def mode_execute():
+    report("fresh")
+    x = jnp.ones((2048, 2048), jnp.bfloat16) * 1e-3
+
+    @jax.jit
+    def long_scan(x):
+        def body(c, _):
+            return c @ c + 0.001, ()
+
+        return jax.lax.scan(body, x, None, length=400)[0]
+
+    jax.block_until_ready(long_scan(x))
+    report("after long scan execute")
+    a = jnp.ones((64, 64, 56, 56), jnp.bfloat16)
+    k = jnp.ones((64, 64, 3, 3), jnp.bfloat16)
+    g = jax.jit(
+        jax.grad(
+            lambda a, k: jax.lax.conv_general_dilated(
+                a, k, (1, 1), "SAME"
+            ).astype(jnp.float32).sum(),
+            argnums=(0, 1),
+        )
+    )
+    jax.block_until_ready(g(a, k))
+    report("after conv fwd+bwd execute")
+
+
+def mode_d2h():
+    report("fresh")
+    x = jax.device_put(np.zeros(4, np.float32))
+    _ = jax.device_get(x)
+    report("after 16-byte device_get")
+    time.sleep(60)
+    report("after 60s idle (no heal)")
+
+
+def mode_closure():
+    report("fresh")
+    const_np = np.ones((256, 256), np.float32)
+    f_np = jax.jit(lambda x: x + const_np)
+    jax.block_until_ready(f_np(jnp.zeros((256, 256))))
+    report("after jit w/ NUMPY closure const")
+    const_dev = jax.device_put(const_np)
+    f_dev = jax.jit(lambda x: x + const_dev)
+    jax.block_until_ready(f_dev(jnp.zeros((256, 256))))
+    report("after jit w/ DEVICE closure const")
+
+
+def mode_firstexec():
+    from bench import _build_solver, _host_batch
+    from sparknet_tpu.utils.rngs import train_key
+
+    s = _build_solver(256, "bfloat16", "caffenet")
+    st = s.init_state(seed=0)
+    rng0 = train_key(0)
+    tau = 4
+    hb = _host_batch(256, "caffenet")
+    batches = {
+        k: np.broadcast_to(v[None], (tau,) + v.shape).copy()
+        for k, v in hb.items()
+    }
+    from bench import PROBE_BYTES, PROBE_IDLE_S  # the shared protocol
+
+    db = jax.device_put(batches)
+    probe = np.random.randint(0, 256, PROBE_BYTES, dtype=np.uint8)
+    t0 = time.perf_counter()
+    st, l = s._jit_step(st, db, rng0)
+    print("dispatch returned %.1fs (compile); is_ready=%s (reports early)"
+          % (time.perf_counter() - t0, l.is_ready()))
+    while True:
+        time.sleep(15)
+        tp = time.perf_counter()
+        jax.block_until_ready(jax.device_put(probe))
+        dt = time.perf_counter() - tp
+        print("t=%4.0fs  put-probe %.3fs %s"
+              % (time.perf_counter() - t0, dt,
+                 "(idle -> first execute drained)" if dt < PROBE_IDLE_S
+                 else "(busy)"))
+        if dt < PROBE_IDLE_S or time.perf_counter() - t0 > 600:
+            break
+
+
+if __name__ == "__main__":
+    mode = sys.argv[1] if len(sys.argv) > 1 else "sizes"
+    print("devices:", jax.devices(), file=sys.stderr)
+    dict(
+        sizes=mode_sizes,
+        execute=mode_execute,
+        d2h=mode_d2h,
+        closure=mode_closure,
+        firstexec=mode_firstexec,
+    )[mode]()
