@@ -1,0 +1,135 @@
+"""Ablation probe for GoogLeNet / ResNet-50 step cost on the real chip
+(deep-model MFU investigation).  Variants drop layer types or flip
+compute dtype; timing is warm + honest device_get close.
+
+Usage: MODEL=googlenet BATCH=128 python tools/deep_probe.py v1 v2 ...
+Variants: base noLRN noDrop noLRNDrop noPool1x1 f32 noBN
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+from sparknet_tpu import models
+from sparknet_tpu.config import replace_data_layers
+from sparknet_tpu.solver import Solver
+
+MODEL = os.environ.get("MODEL", "googlenet")
+BATCH = int(os.environ.get("BATCH", "128"))
+ITERS = int(os.environ.get("ITERS", "20"))
+SHAPE = (3, 224, 224)
+
+
+def drop_layers(netp, types):
+    keep, rename = [], {}
+    for lp in netp.layer:
+        if lp.type in types:
+            if list(lp.top) != list(lp.bottom):
+                rename[lp.top[0]] = lp.bottom[0]
+            continue
+        lp.bottom[:] = [rename.get(b, b) for b in lp.bottom]
+        keep.append(lp)
+    netp.layer[:] = keep
+
+
+def build(mutate=None, dtype="bfloat16"):
+    netp = replace_data_layers(
+        models.load_model(MODEL),
+        [(BATCH,) + SHAPE, (BATCH,)],
+        [(BATCH,) + SHAPE, (BATCH,)],
+    )
+    if mutate:
+        mutate(netp)
+    return Solver(
+        models.load_model_solver(MODEL), net_param=netp,
+        compute_dtype=None if dtype == "f32" else dtype,
+    )
+
+
+def timeit(name, solver):
+    state = solver.init_state(seed=0)
+    rng = np.random.RandomState(0)
+    batch = {
+        "data": rng.randn(BATCH, *SHAPE).astype(np.float32),
+        "label": rng.randint(0, 1000, BATCH).astype(np.float32),
+    }
+    dev = jax.device_put(batch)
+    state, losses = solver.step_repeat(state, dev, tau=ITERS)
+    print("  (warm: %.4f)" % solver.smoothed_loss, file=sys.stderr)
+    best = None
+    for _ in range(2):
+        t0 = time.perf_counter()
+        state, losses = solver.step_repeat(state, dev, tau=ITERS)
+        _ = solver.smoothed_loss  # honest drain
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    print("%-12s %8.1f img/s  %6.2f ms/iter"
+          % (name, BATCH * ITERS / best, best / ITERS * 1e3))
+
+
+def drop_stride1_pools(netp):
+    """Remove shape-preserving (k3 s1 pad1) pooling layers — the
+    inception in-branch pools — to measure their cost."""
+    keep, rename = [], {}
+    for lp in netp.layer:
+        pp = getattr(lp, "pooling_param", None)
+        if (
+            lp.type == "Pooling"
+            and pp is not None
+            and pp.kernel_size == 3
+            and (pp.stride or 1) == 1
+            and pp.pad == 1
+        ):
+            rename[lp.top[0]] = lp.bottom[0]
+            continue
+        lp.bottom[:] = [rename.get(b, b) for b in lp.bottom]
+        keep.append(lp)
+    netp.layer[:] = keep
+
+
+def drop_aux_heads(netp):
+    """Remove GoogLeNet's two auxiliary classifier branches."""
+    netp.layer[:] = [
+        lp for lp in netp.layer
+        if not (lp.name.startswith("loss1/") or lp.name.startswith("loss2/"))
+    ]
+
+
+VARIANTS = {
+    "base": lambda: build(),
+    "noLRN": lambda: build(lambda p: drop_layers(p, {"LRN"})),
+    "noDrop": lambda: build(lambda p: drop_layers(p, {"Dropout"})),
+    "noLRNDrop": lambda: build(lambda p: drop_layers(p, {"LRN", "Dropout"})),
+    "f32": lambda: build(dtype="f32"),
+    "noBN": lambda: build(lambda p: drop_layers(p, {"BatchNorm", "Scale"})),
+    "noPool1": lambda: build(drop_stride1_pools),
+    "noAux": lambda: build(drop_aux_heads),
+    # measurement-only semantics change: stride-1 MAX pools -> AVE
+    # (cheap uniform backward) to isolate select_and_scatter cost
+    "pool1AVE": lambda: build(_pools_to_ave),
+}
+
+
+def _pools_to_ave(netp):
+    for lp in netp.layer:
+        pp = getattr(lp, "pooling_param", None)
+        if (
+            lp.type == "Pooling"
+            and pp is not None
+            and pp.kernel_size == 3
+            and (pp.stride or 1) == 1
+            and pp.pad == 1
+            and pp.pool.upper() == "MAX"
+        ):
+            pp.pool = "AVE"
+
+if __name__ == "__main__":
+    names = sys.argv[1:] or ["base"]
+    print("devices:", jax.devices(), "model", MODEL, file=sys.stderr)
+    for n in names:
+        timeit(n, VARIANTS[n]())

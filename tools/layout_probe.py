@@ -1,0 +1,94 @@
+"""NCHW vs NHWC conv layout on the real chip — fwd+bwd timing for
+representative GoogLeNet inception-branch and ResNet-50 bottleneck
+shapes (the deep-model MFU investigation, VERDICT r4 item 2).
+
+Timing protocol: warm call + device_get sync, then time N calls closed
+by device_get (D2H is safe here — no put loop follows).
+"""
+
+import sys
+import os
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B = int(os.environ.get("B", "128"))
+
+
+def conv(x, k, stride=1, dn=None):
+    return jax.lax.conv_general_dilated(
+        x, k, (stride, stride), "SAME", dimension_numbers=dn
+    )
+
+
+def make_stack(layout):
+    """Inception 4a-ish branch set + a bottleneck, in the given layout."""
+    if layout == "NCHW":
+        dn = ("NCHW", "OIHW", "NCHW")
+        shp = lambda c, h: (B, c, h, h)
+        ker = lambda o, i, k: (o, i, k, k)
+    else:
+        dn = ("NHWC", "HWIO", "NHWC")
+        shp = lambda c, h: (B, h, h, c)
+        ker = lambda o, i, k: (k, k, i, o)
+
+    keys = {}
+    # inception 4a input 14x14x480: branches 1x1x192; 1x1x96->3x3x208;
+    # 1x1x16->5x5x48; pool->1x1x64
+    keys["i_in"] = shp(480, 14)
+    keys["k1"] = ker(192, 480, 1)
+    keys["k2a"] = ker(96, 480, 1)
+    keys["k2b"] = ker(208, 96, 3)
+    keys["k3a"] = ker(16, 480, 1)
+    keys["k3b"] = ker(48, 16, 5)
+    # resnet bottleneck 28x28x512: 1x1x128 -> 3x3x128 -> 1x1x512
+    keys["r_in"] = shp(512, 28)
+    keys["rk1"] = ker(128, 512, 1)
+    keys["rk2"] = ker(128, 128, 3)
+    keys["rk3"] = ker(512, 128, 1)
+
+    rng = np.random.RandomState(0)
+    arrs = {
+        n: jnp.asarray(rng.randn(*s).astype(np.float32) * 0.05, jnp.bfloat16)
+        for n, s in keys.items()
+    }
+    cat_axis = 1 if layout == "NCHW" else 3
+
+    def f(a):
+        xi = a["i_in"]
+        b1 = conv(xi, a["k1"], 1, dn)
+        b2 = conv(jax.nn.relu(conv(xi, a["k2a"], 1, dn)), a["k2b"], 1, dn)
+        b3 = conv(jax.nn.relu(conv(xi, a["k3a"], 1, dn)), a["k3b"], 1, dn)
+        inc = jnp.concatenate([b1, b2, b3], axis=cat_axis)
+        xr = a["r_in"]
+        r = conv(jax.nn.relu(conv(jax.nn.relu(conv(xr, a["rk1"], 1, dn)),
+                                  a["rk2"], 1, dn)), a["rk3"], 1, dn)
+        return (inc.astype(jnp.float32).sum() + r.astype(jnp.float32).sum())
+
+    g = jax.jit(jax.grad(lambda a: f(a)))
+    return g, arrs
+
+
+def bench(layout, iters=30):
+    g, arrs = make_stack(layout)
+    out = g(arrs)
+    jax.block_until_ready(out)
+    _ = jax.device_get(out["k1"])  # honest drain
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = g(out if "i_in" in out else arrs)
+    _ = jax.device_get(out["k1"])
+    dt = (time.perf_counter() - t0) / iters
+    print("%s: %.3f ms/iter" % (layout, dt * 1e3))
+    return dt
+
+
+if __name__ == "__main__":
+    print("devices:", jax.devices(), file=sys.stderr)
+    a = bench("NCHW")
+    b = bench("NHWC")
+    print("NHWC speedup: %.2fx" % (a / b))

@@ -1,0 +1,76 @@
+"""LRN lowering A/B: pad+shifted-slices (current) vs banded-ones matmul
+(window sum as a CxC band contraction on the MXU) — GoogLeNet norm
+shapes, fwd+bwd, bf16."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparknet_tpu.ops.vision import lrn_across_channels, _fast_negpow
+
+B = int(os.environ.get("B", "128"))
+N, ALPHA, BETA, K = 5, 1e-4, 0.75, 1.0
+
+
+def band(c, n, dtype):
+    pad = (n - 1) // 2
+    i = np.arange(c)
+    m = (np.abs(i[:, None] - i[None, :]) <= pad) & (
+        (i[None, :] - i[:, None]) <= (n - 1 - pad)
+    )
+    # caffe window: channels [c-pad, c+n-1-pad]
+    lo = i[:, None] - pad
+    hi = i[:, None] + (n - 1 - pad)
+    m = (i[None, :] >= lo) & (i[None, :] <= hi)
+    return jnp.asarray(m.astype(np.float32), dtype)
+
+
+def lrn_band(x, n, alpha, beta, k):
+    c = x.shape[1]
+    bm = band(c, n, jnp.float32)
+    xf = x.astype(jnp.float32)
+    s = jnp.einsum("nchw,dc->ndhw", xf * xf, bm)
+    scale = k + (alpha / n) * s
+    return (xf * _fast_negpow(scale, beta)).astype(x.dtype)
+
+
+def timeit(name, fn, shapes):
+    rng = np.random.RandomState(0)
+    xs = [jnp.asarray(rng.randn(*s).astype(np.float32), jnp.bfloat16)
+          for s in shapes]
+
+    def loss(xs):
+        return sum(
+            fn(x, N, ALPHA, BETA, K).astype(jnp.float32).sum() for x in xs
+        )
+
+    g = jax.jit(jax.grad(loss))
+    out = g(xs)
+    jax.block_until_ready(out)
+    _ = jax.device_get(out[0])
+    t0 = time.perf_counter()
+    it = 30
+    for _ in range(it):
+        out = g(out)
+    _ = jax.device_get(out[0])
+    dt = (time.perf_counter() - t0) / it
+    print("%-10s %.3f ms/iter" % (name, dt * 1e3))
+
+
+if __name__ == "__main__":
+    shapes = [(B, 64, 56, 56), (B, 192, 56, 56)]
+    print("devices:", jax.devices(), file=sys.stderr)
+    timeit("slices", lrn_across_channels, shapes)
+    timeit("band", lrn_band, shapes)
+    # numerics
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 64, 7, 7).astype(np.float32))
+    a = lrn_across_channels(x, N, ALPHA, BETA, K)
+    b = lrn_band(x, N, ALPHA, BETA, K)
+    print("max abs diff f32:", float(jnp.max(jnp.abs(a - b))))
