@@ -600,6 +600,33 @@ def bench_scaling():
         },
         "tau": tau,
     }
+    # the pmean(θ) cost across a REAL process boundary (2-process
+    # jax.distributed over loopback TCP, average_params=True/False A/B
+    # in subprocesses) — tightens the PERF.md scaling projection with a
+    # measured inter-process collective instead of only the in-process
+    # virtual-mesh number
+    if os.environ.get("BENCH_SCALING_2PROC", "1") != "0":
+        try:
+            import re
+
+            from sparknet_tpu.utils import procs
+
+            repo = os.path.dirname(os.path.abspath(__file__))
+            outs = procs.run_two_process_round(
+                procs.timed_averaging_worker("TIMED2P"), "TIMED2P", repo,
+                timeout=900,
+            )
+            m = re.search(
+                r"avg_ms=([\d.]+) local_ms=([\d.]+) "
+                r"collective_ms=([\d.]+) tau=(\d+)",
+                outs[0],
+            )
+            out["measured_2proc_round_ms"] = float(m.group(1))
+            out["measured_2proc_local_ms"] = float(m.group(2))
+            out["measured_2proc_collective_ms"] = float(m.group(3))
+            out["measured_2proc_tau"] = int(m.group(4))
+        except Exception as e:  # pragma: no cover - diagnostic path
+            out["measured_2proc_error"] = repr(e)[:200]
     if jax.devices()[0].platform == "cpu":
         # virtual devices time-share the host cores: this validates the
         # sweep mechanics (shard_map compiles/executes at every dp), not

@@ -82,3 +82,31 @@ class DataTransformer:
         if p.scale != 1.0:
             x = x * p.scale
         return x
+
+
+def oversample_chw(chw: np.ndarray, crop_h: int, crop_w: int) -> np.ndarray:
+    """10-crop oversampling of one (C, H, W) image: the four corners +
+    center at the crop size, then their horizontal mirrors — the
+    ``caffe.io.oversample`` crop set that ``Classifier.predict(...,
+    oversample=True)`` score-averages (caffe/python/caffe/
+    classifier.py:47-93, caffe/python/caffe/io.py oversample).
+    Returns (10, C, crop_h, crop_w) in that order (corners+center,
+    then mirrors)."""
+    c, h, w = chw.shape
+    if h < crop_h or w < crop_w:
+        raise ValueError(
+            f"oversample source {h}x{w} smaller than crop "
+            f"{crop_h}x{crop_w}"
+        )
+    offs = [
+        (0, 0),
+        (0, w - crop_w),
+        (h - crop_h, 0),
+        (h - crop_h, w - crop_w),
+        ((h - crop_h) // 2, (w - crop_w) // 2),
+    ]
+    crops = [
+        chw[:, oy:oy + crop_h, ox:ox + crop_w] for oy, ox in offs
+    ]
+    crops += [cr[:, :, ::-1] for cr in crops]
+    return np.stack(crops).astype(chw.dtype, copy=False)

@@ -121,15 +121,6 @@ def decode(proto_msg: str, data: bytes):
         if num not in table:
             continue  # unknown field: proto2 readers skip
         name, label, ftype = table[num]
-        # fields whose payload the schema cannot carry must not be
-        # silently dropped
-        if proto_msg in ("LayerParameter", "V1LayerParameter") and (
-            name == "blobs"
-        ):
-            raise ProtoBinError(
-                "layer carries weight blobs — this is a weights file; "
-                "use io/caffemodel.py (load_weights) for it"
-            )
         if proto_msg == "V1LayerParameter" and name == "layer":
             raise ProtoBinError(
                 "V0-era connection message outside a NetParameter "
@@ -229,10 +220,15 @@ def _decode_tokens(proto_msg: str, data: bytes) -> Dict[str, List[Any]]:
         if name == "blobs" and proto_msg in (
             "V0LayerParameter", "V1LayerParameter", "LayerParameter"
         ):
-            raise ProtoBinError(
-                "layer carries weight blobs — this is a weights file; "
-                "use io/caffemodel.py (load_weights) for it"
+            # weight-carrying legacy net: decode the blobs through the
+            # schema codec and carry them alongside the token dict —
+            # the V0 upgrade preserves them in place exactly like the
+            # reference (upgrade_proto.cpp:21-80 copies layer blobs
+            # into the upgraded net)
+            out.setdefault(_BLOBS_KEY, []).append(
+                decode("BlobProto", bytes(value))
             )
+            continue
         # V1 legacy share-name string -> ParamSpec.name (same rule as
         # decode(); V1 entries can sit next to V0 ones in one file)
         if proto_msg == "V1LayerParameter" and name == "param":
@@ -253,10 +249,37 @@ def _decode_tokens(proto_msg: str, data: bytes) -> Dict[str, List[Any]]:
     return out
 
 
+# non-field token-dict key carrying decoded BlobProto objects through
+# the V0 token upgrade (popped before _bind, re-attached positionally)
+_BLOBS_KEY = "\0blobs"
+
+
 def _load_v0_net(data: bytes) -> schema.NetParameter:
     from sparknet_tpu.config import prototext
 
     d = _decode_tokens("NetParameter", data)
+    # lift weight blobs out before the token upgrades walk the dicts.
+    # The upgrade can DROP layers (padding folds into the next conv) but
+    # keeps surviving layers' names, so blobs re-attach by name.
+    blobs_by_name: Dict[str, List[Any]] = {}
+    for e in d.get("layers", []):
+        if not isinstance(e, dict):
+            continue
+        inner = e.get("layer", [None])[0]  # V0 connection sub-message
+        for holder in (e, inner):
+            if not isinstance(holder, dict):
+                continue
+            blobs = holder.pop(_BLOBS_KEY, None)
+            if not blobs:
+                continue
+            name_tok = (holder.get("name") or e.get("name") or [""])[0]
+            name = str(name_tok).replace("\0STR", "", 1)
+            if not name:
+                raise ProtoBinError(
+                    "V0 layer carries weight blobs but no name; cannot "
+                    "re-attach after upgrade"
+                )
+            blobs_by_name.setdefault(name, []).extend(blobs)
     prototext._upgrade_v0_tokens(d)
     # token-level _merge_v1_param_multipliers: entries carrying BOTH
     # param share-names and blobs_lr merge them into the same ParamSpec
@@ -275,7 +298,18 @@ def _load_v0_net(data: bytes) -> schema.NetParameter:
         e.pop("blobs_lr", None)
         e.pop("weight_decay", None)
     # _bind finishes with _upgrade_net (blobs_lr -> ParamSpec, V1 names)
-    return prototext._bind(schema.NetParameter, d, permissive=False)
+    netp = prototext._bind(schema.NetParameter, d, permissive=False)
+    if blobs_by_name:
+        for lp in netp.layer:
+            blobs = blobs_by_name.pop(lp.name, None)
+            if blobs:
+                lp.blobs = blobs
+        if blobs_by_name:
+            raise ProtoBinError(
+                "V0 upgrade dropped weight-carrying layer(s): "
+                + ", ".join(sorted(blobs_by_name))
+            )
+    return netp
 
 
 # ---------------------------------------------------------------------------

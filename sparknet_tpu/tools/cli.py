@@ -403,25 +403,47 @@ def cmd_classify(args) -> int:
         with open(args.labels) as f:
             labels = [l.strip() for l in f if l.strip()]
 
+    if mean is not None and (mean.shape[1] > h or mean.shape[2] > w):
+        # a larger mean image center-crops to the input (the reference
+        # resizes; crop keeps exact mean semantics for the standard
+        # 256-mean/227-input case); (C,1,1) value means broadcast as-is
+        off_h = (mean.shape[1] - h) // 2
+        off_w = (mean.shape[2] - w) // 2
+        mean = mean[:, off_h:off_h + h, off_w:off_w + w]
+
     fwd = jax.jit(net.forward)
     for path in args.images:
         img = Image.open(path).convert("L" if c == 1 else "RGB")
-        img = img.resize((w, h), Image.BILINEAR)
+        if args.oversample:
+            # resize to the oversample source dims, then 10-crop at the
+            # net input size and score-average (classifier.py:47-93)
+            from sparknet_tpu.data.transformer import oversample_chw
+
+            src = args.resize or max(256, h, w)
+            if src < h or src < w:
+                print(
+                    f"classify: --resize {src} is smaller than the net "
+                    f"input {h}x{w}; oversample crops need a larger "
+                    "source",
+                    file=sys.stderr,
+                )
+                return 1
+            img = img.resize((src, src), Image.BILINEAR)
+        else:
+            img = img.resize((w, h), Image.BILINEAR)
         arr = np.asarray(img, np.float32)
         if arr.ndim == 2:
             arr = arr[:, :, None]
         chw = arr.transpose(2, 0, 1)
-        if mean is not None:
-            # a larger mean image center-crops to the input (the
-            # reference resizes; crop keeps exact mean semantics for
-            # the standard 256-mean/227-input case); (C,1,1) value
-            # means broadcast as-is
-            if mean.shape[1] > h or mean.shape[2] > w:
-                off_h = (mean.shape[1] - h) // 2
-                off_w = (mean.shape[2] - w) // 2
-                mean = mean[:, off_h:off_h + h, off_w:off_w + w]
-            chw = chw - mean
-        batch = {data_blob: chw[None]}
+        if args.oversample:
+            crops = oversample_chw(chw, h, w)
+            if mean is not None:
+                crops = crops - mean[None]
+            batch = {data_blob: crops}
+        else:
+            if mean is not None:
+                chw = chw - mean
+            batch = {data_blob: chw[None]}
         blobs = fwd(params, stats, batch)
         # "prob" if the deploy net names one (the BVLC convention),
         # else the last layer's top; apply softmax if the scores are
@@ -431,7 +453,9 @@ def cmd_classify(args) -> int:
             if "prob" in net.blob_shapes
             else net.net_param.layer[-1].top[0]
         )
-        scores = np.asarray(blobs[score_blob])[0].reshape(-1)
+        out = np.asarray(blobs[score_blob])
+        # oversample: average the 10 crops' outputs (classifier.py:81-93)
+        scores = out.reshape(out.shape[0], -1).mean(axis=0)
         if scores.min() < 0 or scores.sum() > 1.001:
             e = np.exp(scores - scores.max())
             scores = e / e.sum()
@@ -480,8 +504,8 @@ def cmd_upgrade_net_proto_binary(args) -> int:
     """``upgrade_net_proto_binary IN OUT`` — rewrite a legacy (V0/V1)
     *binary* NetParameter in the modern binary format (reference:
     ``caffe/tools/upgrade_net_proto_binary.cpp``; codec:
-    ``io/protobin.py``).  Weight files are refused with a pointer to
-    the caffemodel importer."""
+    ``io/protobin.py``).  Weight-carrying nets upgrade in place — layer
+    blobs ride through like upgrade_proto.cpp:21-80 copies them."""
     from sparknet_tpu.io import protobin
 
     netp = protobin.load_net_binary(args.input)  # upgrades on load
@@ -727,6 +751,15 @@ def main(argv=None) -> int:
                    help="mean.binaryproto path or comma-separated values")
     p.add_argument("--labels", default=None, help="one class name per line")
     p.add_argument("--topk", type=int, default=5)
+    p.add_argument(
+        "--oversample", action="store_true",
+        help="10-crop (corners+center and mirrors) score averaging "
+        "(classifier.py predict(oversample=True))",
+    )
+    p.add_argument(
+        "--resize", type=int, default=0,
+        help="oversample source size (default max(256, input))",
+    )
     p.set_defaults(fn=cmd_classify)
 
     for name, fn in (

@@ -108,7 +108,14 @@ class Detector:
 
     # -- preprocessing ----------------------------------------------------
 
-    def _preprocess(self, window_hwc: np.ndarray) -> np.ndarray:
+    def _preprocess(self, window_hwc: np.ndarray, content=None) -> np.ndarray:
+        """Mean-subtract + scale one crop.  ``content`` is the
+        (pad_h, pad_w, (warped_h, warped_w)) geometry from crop_window:
+        the zero-padded border outside it is masked back to zero AFTER
+        mean subtraction, so the net sees zero-signal padding exactly
+        like WindowSampler training batches (the reference detector pads
+        with the mean so the net likewise sees 0 post-subtraction,
+        detector.py:96-108)."""
         chw = window_hwc.transpose(2, 0, 1).astype(np.float32)
         if self.mean is not None:
             if self.mean.ndim == 1:
@@ -119,22 +126,29 @@ class Detector:
                 chw = chw - self.mean[
                     :, off_h:off_h + self.crop_h, off_w:off_w + self.crop_w
                 ]
+            if content is not None:
+                pad_h, pad_w, (wh, ww) = content
+                mask = np.zeros(chw.shape[1:], bool)
+                mask[pad_h:pad_h + wh, pad_w:pad_w + ww] = True
+                chw = np.where(mask[None], chw, 0.0)
         if self.input_scale is not None:
             chw = chw * self.input_scale
         return chw
 
-    def crop(self, im: np.ndarray, window: Sequence[float]) -> np.ndarray:
+    def crop(self, im: np.ndarray, window: Sequence[float]):
         """Crop one (ymin, xmin, ymax, xmax) window (context-padded) —
-        ``Detector.crop`` analog, returns (H, W, C) float32."""
+        ``Detector.crop`` analog.  Returns ``(out_hwc, content)`` where
+        ``content`` is the (pad_h, pad_w, warped_shape) geometry that
+        _preprocess uses to keep padding at zero signal."""
         from sparknet_tpu.data.windows import crop_window
 
         ymin, xmin, ymax, xmax = [float(v) for v in window]
-        out, _, _, _ = crop_window(
+        out, pad_h, pad_w, warped = crop_window(
             im, xmin, ymin, xmax - 1, ymax - 1, self.crop_h,
             context_pad=self.context_pad,
             square=self.crop_mode == "square",
         )
-        return out
+        return out, (pad_h, pad_w, warped)
 
     # -- scoring ----------------------------------------------------------
 
@@ -186,7 +200,8 @@ class Detector:
                             f"[{im.min()}, {im.max()}]"
                         )
             for window in windows:
-                inputs.append(self._preprocess(self.crop(im, window)))
+                out, content = self.crop(im, window)
+                inputs.append(self._preprocess(out, content))
                 meta.append((name, np.asarray(window)))
         preds = self._score(inputs)
         return [

@@ -188,6 +188,18 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     app_argv = [a for a in args.app_argv if a != "--"]
 
+    if args.app in provision.ACTIONS:
+        # lifecycle action given after launcher flags: the flags don't
+        # apply to provisioning — require the action-first form instead
+        # of falling into the app path (which would KeyError on APPS)
+        print(
+            f"launch: lifecycle action {args.app!r} must come first: "
+            f"`launch {args.app} ...` (launcher flags like --nprocs do "
+            "not apply to provisioning)",
+            file=sys.stderr,
+        )
+        return 2
+
     if args.nprocs:
         return spawn_local(args, app_argv)
     return run_app(

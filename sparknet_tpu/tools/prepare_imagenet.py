@@ -97,29 +97,51 @@ def dir_image_reader(root: str) -> Callable[[str], bytes]:
     return read
 
 
-def nested_tar_reader(path: str) -> Callable[[str], bytes]:
-    """Index an ILSVRC-style tar-of-subtars so members are fetched by
-    ``<subtar-stem>/<image>`` (build_index analog): only TarInfo
-    member records are cached — bytes are re-read from disk on demand
-    through kept-open handles, so memory stays flat across the real
-    138 GB train tar (the reference keeps ``filehandles`` the same
-    way)."""
-    outer = tarfile.open(path)
-    index: Dict[str, Tuple[tarfile.TarFile, tarfile.TarInfo]] = {}
-    by_basename: Dict[str, str] = {}
-    for member in outer.getmembers():
-        # real tars carry directory entries / stray non-tar files next to
-        # the class sub-tars; only regular .tar members are sub-tars
-        if not member.isfile() or not member.name.endswith(".tar"):
-            continue
-        stem = os.path.splitext(os.path.basename(member.name))[0]
-        # extractfile gives a seekable view over the (uncompressed)
-        # outer tar, so the sub TarFile can random-access members later
-        sub = tarfile.open(fileobj=outer.extractfile(member))
-        for m in sub.getmembers():
-            key = f"{stem}/{m.name}"
-            index[key] = (sub, m)
-            by_basename[os.path.basename(m.name)] = key
+def build_tar_index(path: str) -> Dict[str, Tuple[int, int]]:
+    """Index an ILSVRC-style tar-of-subtars: ``<subtar-stem>/<image>`` ->
+    (absolute byte offset of the member data in the OUTER file, size).
+    Both tars are uncompressed, so a member's bytes live at
+    ``outer_member.offset_data + inner_member.offset_data`` and can be
+    served by plain seek+read on one file handle.  The index is ints
+    only — picklable and compact — so the parent builds it ONCE and
+    ships it to pool workers; having every worker re-run getmembers()
+    would re-read the whole (138 GB) train tar and hold a TarInfo per
+    image per process (ADVICE r4)."""
+    index: Dict[str, Tuple[int, int]] = {}
+    with open(path, "rb") as probe:
+        magic = probe.read(2)
+    if magic in (b"\x1f\x8b", b"BZ", b"\xfd7"):  # gz / bz2 / xz
+        raise ValueError(
+            f"{path}: compressed tars are not seekable by raw offset — "
+            "decompress the outer tar first (ILSVRC ships uncompressed)"
+        )
+    with tarfile.open(path) as outer:
+        for member in outer.getmembers():
+            # real tars carry directory entries / stray non-tar files
+            # next to the class sub-tars; only regular .tar members are
+            # sub-tars
+            if not member.isfile() or not member.name.endswith(".tar"):
+                continue
+            stem = os.path.splitext(os.path.basename(member.name))[0]
+            base = member.offset_data
+            with tarfile.open(fileobj=outer.extractfile(member)) as sub:
+                for m in sub.getmembers():
+                    if not m.isfile():
+                        continue
+                    index[f"{stem}/{m.name}"] = (base + m.offset_data, m.size)
+    return index
+
+
+def nested_tar_reader(
+    path: str, index: Optional[Dict[str, Tuple[int, int]]] = None
+) -> Callable[[str], bytes]:
+    """Fetch members of a tar-of-subtars by ``<subtar-stem>/<image>``
+    via the offset index (built here if not supplied); bytes are read
+    on demand through one kept-open handle, so memory stays flat."""
+    if index is None:
+        index = build_tar_index(path)
+    by_basename = {os.path.basename(k): k for k in index}
+    fh = open(path, "rb")
 
     def read(name: str) -> bytes:
         entry = index.get(name)
@@ -129,21 +151,24 @@ def nested_tar_reader(path: str) -> Callable[[str], bytes]:
             if key is None:
                 raise KeyError(name)
             entry = index[key]
-        sub, m = entry
-        return sub.extractfile(m).read()
+        off, size = entry
+        fh.seek(off)
+        return fh.read(size)
 
     return read
 
 
-# reader spec -> reader, rebuilt once per worker process (closures over
-# open tar handles are not picklable)
-ReaderSpec = Tuple[str, str]  # ("dir"|"tar", path)
+# reader spec -> reader, rebuilt once per worker process (open handles
+# are not picklable; the tar OFFSET INDEX is, and rides in the spec so
+# workers skip the full-tar re-index)
+ReaderSpec = tuple  # ("dir", path) | ("tar", path, offset_index)
 _WORKER_READER: Optional[Callable[[str], bytes]] = None
 
 
 def _make_reader(spec: ReaderSpec) -> Callable[[str], bytes]:
-    kind, path = spec
-    return dir_image_reader(path) if kind == "dir" else nested_tar_reader(path)
+    if spec[0] == "dir":
+        return dir_image_reader(spec[1])
+    return nested_tar_reader(spec[1], spec[2] if len(spec) > 2 else None)
 
 
 def _init_worker(spec: ReaderSpec) -> None:
@@ -220,7 +245,8 @@ def _prepare_split(
                 "(nested tars carry no label information)"
             )
         pairs = read_label_file(labels_path)
-        reader_spec = ("tar", src_tar)
+        # index once in the parent; workers get the picklable offsets
+        reader_spec = ("tar", src_tar, build_tar_index(src_tar))
     # the read side keys labels by BASENAME (ImageNetLoader.scala:41-54
     # semantics) — colliding basenames would silently corrupt labels, so
     # the producer refuses them

@@ -88,6 +88,91 @@ def toy_averaging_worker(marker: str) -> str:
     return _TOY_AVERAGING_WORKER.replace("@MARKER@", marker)
 
 
+# Timed variant: measures the pmean(θ) collective's wall-clock share of
+# an averaging round ACROSS A REAL PROCESS BOUNDARY (jax.distributed over
+# loopback TCP) via the same average_params=True/False A/B bench_scaling
+# uses on the virtual mesh.  Model sized so θ is ~0.5 MB — big enough
+# for the collective to be measurable, small enough for CPU workers.
+_TIMED_AVERAGING_WORKER = r"""
+import sys
+import time
+import numpy as np
+
+pid, port = int(sys.argv[1]), sys.argv[2]
+
+import jax
+
+from sparknet_tpu import config
+from sparknet_tpu.parallel import ParameterAveragingTrainer
+from sparknet_tpu.parallel.mesh import initialize_distributed, make_mesh
+from sparknet_tpu.solver import Solver
+
+initialize_distributed(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+)
+
+NET = '''
+name: "timed"
+layer { name: "data" type: "HostData" top: "x" top: "label"
+  java_data_param { shape { dim: 16 dim: 256 } shape { dim: 16 } } }
+layer { name: "ip1" type: "InnerProduct" bottom: "x" top: "h"
+  inner_product_param { num_output: 256 weight_filler { type: "xavier" } } }
+layer { name: "relu1" type: "ReLU" bottom: "h" top: "h" }
+layer { name: "ip2" type: "InnerProduct" bottom: "h" top: "logits"
+  inner_product_param { num_output: 128 weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "logits"
+  bottom: "label" top: "loss" }
+'''
+sp = config.parse_solver_prototxt(
+    'base_lr: 0.01 lr_policy: "fixed" momentum: 0.9'
+)
+mesh = make_mesh({"dp": 4})
+TAU, ROUNDS = 10, 10
+
+rng = np.random.RandomState(0)
+from jax.sharding import NamedSharding, PartitionSpec as P
+sharding = NamedSharding(mesh, P("dp"))
+full = {
+    "x": rng.randn(4, TAU, 16, 256).astype(np.float32),
+    "label": rng.randint(0, 128, (4, TAU, 16)).astype(np.float32),
+}
+batches = {
+    k: jax.make_array_from_callback(
+        v.shape, sharding, lambda idx, v=v: v[idx]
+    )
+    for k, v in full.items()
+}
+
+
+def timed(average_params):
+    solver = Solver(sp, net_param=config.parse_net_prototxt(NET))
+    trainer = ParameterAveragingTrainer(
+        solver, mesh, average_params=average_params
+    )
+    state = trainer.init_state(seed=0)
+    state, losses = trainer.round(state, batches)  # compile + warm
+    jax.block_until_ready(losses)
+    t0 = time.perf_counter()
+    for _ in range(ROUNDS):
+        state, losses = trainer.round(state, batches)
+    jax.block_until_ready(losses)
+    return (time.perf_counter() - t0) / ROUNDS
+
+
+avg = timed(True)
+local = timed(False)
+coll_ms = max(0.0, (avg - local) * 1e3)
+print(
+    f"@MARKER@ p{pid} avg_ms={avg * 1e3:.3f} local_ms={local * 1e3:.3f} "
+    f"collective_ms={coll_ms:.3f} tau={TAU}"
+)
+"""
+
+
+def timed_averaging_worker(marker: str) -> str:
+    return _TIMED_AVERAGING_WORKER.replace("@MARKER@", marker)
+
+
 def run_two_process_round(
     worker_src: str,
     marker: str,

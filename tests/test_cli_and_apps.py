@@ -281,6 +281,84 @@ layer { name: "prob" type: "Softmax" bottom: "fc" top: "prob" }
     assert '"red-thing"' in first  # the biased class ranks first
 
 
+def test_oversample_chw_crop_set():
+    """The 10-crop set is corners+center then mirrors, at the crop size
+    (io.py oversample order)."""
+    from sparknet_tpu.data.transformer import oversample_chw
+
+    chw = np.arange(3 * 6 * 6, dtype=np.float32).reshape(3, 6, 6)
+    crops = oversample_chw(chw, 4, 4)
+    assert crops.shape == (10, 3, 4, 4)
+    np.testing.assert_array_equal(crops[0], chw[:, :4, :4])  # top-left
+    np.testing.assert_array_equal(crops[3], chw[:, 2:, 2:])  # bottom-right
+    np.testing.assert_array_equal(crops[4], chw[:, 1:5, 1:5])  # center
+    # mirrors of the first five, horizontally flipped
+    for i in range(5):
+        np.testing.assert_array_equal(crops[5 + i], crops[i][:, :, ::-1])
+
+
+def test_cli_classify_oversample(tmp_path, capsys):
+    """--oversample score-averages the 10-crop set: on an image whose
+    left and right halves activate different classes, the averaged
+    scores sit between the single-crop extremes and the flag changes
+    the center-crop-only prediction (classifier.py:47-93)."""
+    from PIL import Image
+
+    from sparknet_tpu.io import caffemodel
+    from sparknet_tpu.net import JaxNet
+
+    deploy = tmp_path / "deploy.prototxt"
+    deploy.write_text("""
+name: "tiny"
+layer { name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 1 dim: 3 dim: 8 dim: 8 } } }
+layer { name: "fc" type: "InnerProduct" bottom: "data" top: "fc"
+  inner_product_param { num_output: 3 weight_filler { type: "xavier" } } }
+""")
+    netp = config.load_net_prototxt(str(deploy))
+    net = JaxNet(netp, phase="TEST")
+    params, stats = net.init(3)
+    # class 0 scores the LEFT half of the red channel, class 2 the RIGHT
+    w = np.zeros((3, 3 * 8 * 8), np.float32)
+    pix = np.zeros((8, 8), np.float32)
+    pix[:, :4] = 0.05
+    w[0, : 8 * 8] = pix.reshape(-1)
+    w[2, : 8 * 8] = pix[:, ::-1].reshape(-1)
+    params["fc"] = [np.asarray(w), np.zeros(3, np.float32)]
+    weights = tmp_path / "tiny.caffemodel"
+    caffemodel.save_weights(
+        caffemodel.net_blobs(net, params, stats), str(weights)
+    )
+
+    # 32x32 source: red only in the left 10 columns — corner crops see
+    # it strongly, the center crop barely does
+    img = np.zeros((32, 32, 3), np.uint8)
+    img[:, :10, 0] = 255
+    Image.fromarray(img).save(tmp_path / "half.png")
+
+    def run(*extra):
+        rc = cli.main([
+            "classify", f"--model={deploy}", f"--weights={weights}",
+            "--topk=3", *extra, str(tmp_path / "half.png"),
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        scores = {}
+        for line in out.splitlines():
+            if '- "' in line:
+                v, name = line.split(" - ")
+                scores[name.strip().strip('"')] = float(v)
+        return scores
+
+    center = run()
+    over = run("--oversample", "--resize=32")
+    # mirrors average the left/right asymmetry away: under oversampling
+    # class 0 and class 2 tie (every crop has a mirrored twin)
+    assert abs(over["class 0"] - over["class 2"]) < 1e-4
+    # the center crop alone is left-dominant (red reaches past center)
+    assert center["class 0"] > center["class 2"] + 1e-4
+
+
 def test_cli_classify_derives_deploy_view(tmp_path, toy_model, capsys):
     """A train/test config classifies anyway: the deploy view (Input +
     prob) is derived on the fly, like the BVLC deploy.prototxts."""

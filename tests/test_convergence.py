@@ -98,7 +98,11 @@ def test_committed_teacher_log_meets_expectations():
     }
     assert set(finals) == {"bf16", "f32"}, finals
     for tag, acc in finals.items():
-        assert 0.20 < acc < 0.95, (tag, acc)  # neither chance nor ceiling
+        # tightened from the original barn-door (0.20, 0.95) to ±0.05
+        # around the measured 0.2335 (round-4 verdict item 3): a
+        # regression in optimizer/schedule/precision must move the
+        # committed-artifact value out of this band
+        assert 0.185 < acc < 0.285, (tag, acc)
     assert abs(finals["bf16"] - finals["f32"]) < 0.05, finals
 
     # train loss actually fell (the student fits the teacher surface)

@@ -147,6 +147,28 @@ def test_detector_context_pad_runs():
     assert np.isfinite(dets[0]["prediction"]).all()
 
 
+def test_detector_context_pad_mean_keeps_padding_zero():
+    """With context_pad + a mean, the zero-padded border must stay at
+    zero signal after mean subtraction (R-CNN standard config;
+    WindowSampler training batches behave the same — ADVICE r4)."""
+    netp = config.parse(DEPLOY, config.NetParameter)
+    mean = np.full(3, 100.0, np.float32)
+    det = Detector(netp, mean=mean, context_pad=2, batch=1)
+    # window at the image corner: the context overhangs the image, so
+    # crop_window zero-pads the top-left of the crop
+    im = _red_blue_image()
+    out, content = det.crop(im, (0, 0, 6, 6))
+    pad_h, pad_w, (wh, ww) = content
+    assert pad_h > 0 and pad_w > 0  # the config actually padded
+    chw = det._preprocess(out, content)
+    # padded border: exactly zero (NOT -mean)
+    assert np.all(chw[:, :pad_h, :] == 0.0)
+    assert np.all(chw[:, :, :pad_w] == 0.0)
+    # content region: mean actually subtracted (image corner is red 200
+    # or black 0, never equal to the 100 mean everywhere)
+    assert np.any(chw[:, pad_h:pad_h + wh, pad_w:pad_w + ww] != 0.0)
+
+
 def test_detector_derives_deploy_view():
     """A train/test config (HostData + loss) reduces via deploy_variant."""
     netp = models.load_model("lenet")

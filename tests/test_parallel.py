@@ -343,3 +343,73 @@ def test_scaling_sweep_round_invariants():
                 arr = np.asarray(blob)
                 for w in range(1, dp):
                     np.testing.assert_array_equal(arr[0], arr[w])
+
+
+def test_tp_policy_actually_partitions_matmuls():
+    """The mp-axis param placement must make GSPMD PARTITION the big
+    matmuls — not all-gather the weights and run full-size dots per
+    device.  Verified on the compiled (post-SPMD-partitioner) HLO: the
+    per-device dot output carries num_output/mp channels, and no
+    full-width dot survives (round-4 verdict item 8)."""
+    import re
+
+    from sparknet_tpu.solver import Solver
+
+    wide = 512  # >= 4096 elements and divisible by mp=2 -> policy triggers
+    netp = config.parse_net_prototxt(
+        """
+        name: "tp"
+        layer { name: "data" type: "HostData" top: "x" top: "label"
+          java_data_param { shape { dim: 8 dim: 16 } shape { dim: 8 } } }
+        layer { name: "ip1" type: "InnerProduct" bottom: "x" top: "h"
+          inner_product_param { num_output: %d
+            weight_filler { type: "xavier" } } }
+        layer { name: "relu1" type: "ReLU" bottom: "h" top: "h" }
+        layer { name: "ip2" type: "InnerProduct" bottom: "h" top: "logits"
+          inner_product_param { num_output: 4
+            weight_filler { type: "xavier" } } }
+        layer { name: "loss" type: "SoftmaxWithLoss" bottom: "logits"
+          bottom: "label" top: "loss" }
+        """
+        % wide
+    )
+    sp = config.parse_solver_prototxt(
+        'base_lr: 0.01 lr_policy: "fixed" momentum: 0.9'
+    )
+    solver = Solver(sp, net_param=netp)
+    mesh = make_mesh({"dp": 2, "mp": 2}, devices=jax.devices()[:4])
+    trainer = AllReduceTrainer(solver, mesh, mp_axis="mp")
+
+    # the policy picked the sharded placement for ip1 (512x16 weight)
+    sh = trainer._state_shardings.params["ip1"][0]
+    assert sh.spec == jax.sharding.PartitionSpec("mp", None), sh.spec
+
+    state = trainer.init_state(seed=0)
+    batches = {
+        "x": np.broadcast_to(
+            np.random.RandomState(0).randn(2, 16, 16).astype(np.float32),
+            (2, 16, 16),
+        ).copy(),
+        "label": np.random.RandomState(1)
+        .randint(0, 4, (2, 16))
+        .astype(np.float32),
+    }
+    from sparknet_tpu.utils.rngs import train_key
+
+    compiled = trainer._jit_round.lower(
+        state, jax.device_put(batches, trainer._batch_sharding), train_key(0)
+    ).compile()
+    hlo = compiled.as_text()
+    # post-partitioning module: per-device dots must be 256-wide...
+    half_dots = re.findall(
+        r"= f32\[\d+,%d\]\{[0-9,]*\} dot\(" % (wide // 2), hlo
+    )
+    assert half_dots, "no %d-wide per-device dot found" % (wide // 2)
+    # ...and no full-width 512 dot may survive anywhere (that would mean
+    # GSPMD all-gathered the weights and re-ran the full matmul)
+    full_dots = re.findall(r"= f32\[\d+,%d\]\{[0-9,]*\} dot\(" % wide, hlo)
+    assert not full_dots, full_dots[:3]
+
+    # and the round still runs + stays finite with tp placement live
+    state, losses = trainer.step(state, batches)
+    assert np.isfinite(np.asarray(losses)).all()

@@ -109,15 +109,20 @@ def test_solver_binary_upgrades_legacy(tmp_path):
     assert sp.net_param.layer[0].type == "InnerProduct"
 
 
-def test_weight_files_are_refused(tmp_path):
-    # a layer carrying BlobProto weights is a caffemodel, not a net def
-    blob_proto = wire.field_varint(2, 1)  # count-ish field
+def test_weight_carrying_modern_net_loads(tmp_path):
+    # a layer carrying BlobProto weights (a caffemodel IS a
+    # NetParameter) loads with the blobs decoded — the reference's
+    # ReadNetParamsFromBinaryFile never refuses
+    blob_proto = wire.field_varint(2, 1) + _f32(5, 7.0)
     layer = wire.field_bytes(1, b"ip") + wire.field_bytes(7, blob_proto)
     data = wire.field_bytes(100, layer)  # modern 'layer' field
     p = tmp_path / "weights.binaryproto"
     p.write_bytes(data)
-    with pytest.raises(protobin.ProtoBinError, match="caffemodel"):
-        protobin.load_net_binary(str(p))
+    netp = protobin.load_net_binary(str(p))
+    (lp,) = netp.layer
+    assert lp.name == "ip" and len(lp.blobs) == 1
+    assert lp.blobs[0].channels == 1
+    assert list(lp.blobs[0].data) == [7.0]
 
 
 def test_upgrade_net_proto_binary_cli(tmp_path):
@@ -272,15 +277,62 @@ def test_v0_binary_net_upgrades(tmp_path):
     assert prototext.dumps(back) == prototext.dumps(netp)
 
 
-def test_v0_binary_weight_file_refused(tmp_path):
-    inner = wire.field_bytes(1, b"ip") + wire.field_bytes(
-        50, wire.field_varint(2, 1)  # V0 blobs
+def test_v0_binary_weight_carrying_net_upgrades_in_place(tmp_path):
+    """A V0 net whose layers carry weight BlobProtos upgrades with the
+    blobs preserved — upgrade_proto.cpp:21-80 copies layer blobs into
+    the upgraded net; the padding-layer fold must not misalign them
+    (round-4 verdict item 7)."""
+    w = np.arange(2 * 3 * 3 * 3, dtype=np.float32)
+    blob = (
+        wire.field_varint(1, 2) + wire.field_varint(2, 3)   # num, channels
+        + wire.field_varint(3, 3) + wire.field_varint(4, 3)  # h, w
+        + b"".join(_f32(5, v) for v in w)                    # data
     )
-    data = _v0_conn(inner)
+    bias = wire.field_varint(1, 1) + wire.field_varint(2, 2) + \
+        wire.field_varint(3, 1) + wire.field_varint(4, 1) + \
+        _f32(5, 0.5) + _f32(5, -0.5)
+    pad_l = (
+        wire.field_bytes(1, b"pad1")
+        + wire.field_bytes(2, b"padding")
+        + wire.field_varint(7, 1)
+    )
+    conv = (
+        wire.field_bytes(1, b"conv1")
+        + wire.field_bytes(2, b"conv")
+        + wire.field_varint(3, 2)   # num_output
+        + wire.field_varint(8, 3)   # kernelsize
+        + wire.field_bytes(50, blob)   # V0 blobs
+        + wire.field_bytes(50, bias)
+    )
+    net = (
+        wire.field_bytes(1, b"v0w")
+        + wire.field_bytes(3, b"data")
+        + wire.field_varint(4, 1) + wire.field_varint(4, 3)
+        + wire.field_varint(4, 8) + wire.field_varint(4, 8)
+        + _v0_conn(pad_l, [b"data"], [b"pad1"])
+        + _v0_conn(conv, [b"pad1"], [b"conv1"])
+    )
     p = tmp_path / "v0w.binaryproto"
-    p.write_bytes(wire.field_bytes(1, b"n") + data)
-    with pytest.raises(protobin.ProtoBinError, match="caffemodel"):
-        protobin.load_net_binary(str(p))
+    p.write_bytes(net)
+
+    netp = protobin.load_net_binary(str(p))
+    (c,) = netp.layer  # padding folded away
+    assert c.type == "Convolution" and c.convolution_param.pad == [1]
+    assert len(c.blobs) == 2
+    assert (c.blobs[0].num, c.blobs[0].channels) == (2, 3)
+    np.testing.assert_array_equal(np.asarray(c.blobs[0].data), w)
+    np.testing.assert_array_equal(np.asarray(c.blobs[1].data), [0.5, -0.5])
+
+    # CLI round-trip: upgraded output is a modern binary fixed point
+    # with the weights still aboard
+    from sparknet_tpu.tools import cli
+
+    out = tmp_path / "upgraded.binaryproto"
+    assert cli.main(["upgrade_net_proto_binary", str(p), str(out)]) == 0
+    back = protobin.load_net_binary(str(out))
+    assert len(back.layer[0].blobs) == 2
+    np.testing.assert_array_equal(np.asarray(back.layer[0].blobs[0].data), w)
+    assert prototext.dumps(back) == prototext.dumps(netp)
 
 
 def test_v0_text_padding_folds_too():
@@ -330,7 +382,7 @@ def test_v0_weight_file_loads_via_caffemodel(tmp_path):
 def test_mixed_v0_v1_binary_net(tmp_path):
     """V1 entries (enum type, legacy param string, blobs_lr) sitting next
     to V0 connections in one file upgrade together; V1-carried weight
-    blobs are still refused on the token path."""
+    blobs upgrade in place on the token path too."""
     v0 = wire.field_bytes(1, b"c1") + wire.field_bytes(2, b"conv") \
         + wire.field_varint(3, 2) + wire.field_varint(8, 3)
     v1 = (
@@ -358,15 +410,25 @@ def test_mixed_v0_v1_binary_net(tmp_path):
     assert ip.param[0].lr_mult == 3.0
     assert not ip.blobs_lr
 
-    # V1-carried weights refuse on the token path too
-    v1_w = wire.field_bytes(4, b"w") + wire.field_bytes(
-        6, wire.field_varint(1, 1)
+    # V1-carried weights ride through the token path too
+    v1_w = (
+        wire.field_bytes(4, b"w")
+        + wire.field_varint(5, 14)  # INNER_PRODUCT
+        + wire.field_bytes(6, wire.field_varint(1, 1) + _f32(5, 2.5))
+        + wire.field_bytes(2, b"c1") + wire.field_bytes(3, b"w")
     )
-    bad = _v0_conn(v0, [b"data"], [b"c1"]) + wire.field_bytes(2, v1_w)
+    mixed_w = (
+        wire.field_bytes(1, b"mw")
+        + wire.field_bytes(3, b"data")
+        + wire.field_varint(4, 1) + wire.field_varint(4, 3)
+        + wire.field_varint(4, 8) + wire.field_varint(4, 8)
+        + _v0_conn(v0, [b"data"], [b"c1"])
+        + wire.field_bytes(2, v1_w)
+    )
     p2 = tmp_path / "mixed_w.binaryproto"
-    p2.write_bytes(bad)
-    with pytest.raises(protobin.ProtoBinError, match="caffemodel"):
-        protobin.load_net_binary(str(p2))
+    p2.write_bytes(mixed_w)
+    netp2 = protobin.load_net_binary(str(p2))
+    assert list(netp2.layer[1].blobs[0].data) == [2.5]
 
 
 def test_solver_with_embedded_v0_net(tmp_path):

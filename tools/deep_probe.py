@@ -3,7 +3,7 @@
 compute dtype; timing is warm + honest device_get close.
 
 Usage: MODEL=googlenet BATCH=128 python tools/deep_probe.py v1 v2 ...
-Variants: base noLRN noDrop noLRNDrop noPool1x1 f32 noBN
+Variants: base noLRN noDrop noLRNDrop noPool1 noAux pool1AVE f32 noBN
 """
 
 import os

@@ -21,9 +21,6 @@ N, ALPHA, BETA, K = 5, 1e-4, 0.75, 1.0
 def band(c, n, dtype):
     pad = (n - 1) // 2
     i = np.arange(c)
-    m = (np.abs(i[:, None] - i[None, :]) <= pad) & (
-        (i[None, :] - i[:, None]) <= (n - 1 - pad)
-    )
     # caffe window: channels [c-pad, c+n-1-pad]
     lo = i[:, None] - pad
     hi = i[:, None] + (n - 1 - pad)
