@@ -40,15 +40,25 @@ os.environ.setdefault(
 DP, TAU = 8, 10
 
 
+BATCH = 50  # halved from the config's 100: the A/B runs on a 1-core
+# CPU host and compares averaging rules at matched samples, where the
+# absolute batch size is not the object under test
+
+
 def _solver(dtype=None):
     from sparknet_tpu import models
+    from sparknet_tpu.config import replace_data_layers
     from sparknet_tpu.solver import Solver
 
     # quick model, fixed-lr leg of its schedule (the A/B compares
     # averaging rules, not schedules)
     sp = models.load_model_solver("cifar10_quick")
     sp.lr_policy = "fixed"
-    return Solver(sp, compute_dtype=dtype)
+    shapes = [(BATCH, 3, 32, 32), (BATCH,)]
+    netp = replace_data_layers(
+        models.load_model("cifar10_quick"), shapes, shapes
+    )
+    return Solver(sp, net_param=netp, compute_dtype=dtype)
 
 
 def _eval_acc(solver, state_host, test_batches, n_test_batches):
@@ -167,8 +177,8 @@ def run_allreduce(Xtr, Ytr, test_batches, ntb, total_iters, log):
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--total_iters", type=int, default=4800)
-    parser.add_argument("--n", type=int, default=8000)
+    parser.add_argument("--total_iters", type=int, default=2400)
+    parser.add_argument("--n", type=int, default=6000)
     parser.add_argument("--n_test", type=int, default=1000)
     args = parser.parse_args(argv)
 
