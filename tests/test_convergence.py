@@ -138,3 +138,31 @@ def test_teacher_tool_short_run(tmp_path):
     )
     assert out.returncode == 0, out.stdout + out.stderr
     assert "headline:" in out.stdout
+
+
+def test_committed_dp_ab_log_meets_expectations():
+    """The dp A/B artifact (tools/run_dp_ab.py, 8-device virtual mesh,
+    matched total samples) must show τ-averaging converging comparably
+    to single-worker SGD on the teacher task — the SparkNet paper's
+    central dynamics claim (τ-local SGD quality, CifarApp.scala:95-136).
+    Averaging within a few points of single-worker; all runs well above
+    chance (0.10)."""
+    import glob
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    logs = sorted(glob.glob(os.path.join(repo, "training_log_*_dp_ab.txt")))
+    assert logs, "committed dp_ab artifact missing"
+    text = open(logs[-1]).read()
+    m = re.search(
+        r"headline: single (\d\.\d+) avg_dp8 (\d\.\d+) "
+        r"allreduce (\d\.\d+)",
+        text,
+    )
+    assert m, text[-500:]
+    single, avg, allr = (float(m.group(i)) for i in (1, 2, 3))
+    for name, acc in (("single", single), ("avg_dp8", avg),
+                      ("allreduce", allr)):
+        assert acc > 0.15, (name, acc)  # well above chance
+    # τ-averaging lands within a few points of plain SGD
+    assert abs(avg - single) < 0.08, (single, avg)
