@@ -70,8 +70,11 @@ _MODEL_BASELINE_IMG_S = {
 
 
 def jnp_sum_scalar(x):
-    """Force execution with a scalar-sized transfer (full-array syncs
-    crawl at ~25 MB/s through the remote-TPU tunnel)."""
+    """Force execution with a scalar-sized device->host transfer.  Any
+    D2H flips the relay's put lane into its degraded mode (PERF.md
+    "Relay transfer degradation"), so callers must place this AFTER all
+    host->device traffic they care about — bench_train can use it
+    between passes because its batch stays device-resident."""
     import jax.numpy as jnp
 
     return jnp.sum(x.astype(jnp.float32))

@@ -378,3 +378,49 @@ def test_bf16_f32_train_curve_equivalence_cifar():
         m32 = f32[w * tau : (w + 1) * tau].mean()
         m16 = bf16[w * tau : (w + 1) * tau].mean()
         assert abs(m16 - m32) / m32 < 0.10, (w, m32, m16)
+
+
+def test_note_losses_is_lazy_bounded_and_exact():
+    """smoothed_loss must not pull losses to host until read (the hot
+    loop stays free of device->host syncs — PERF.md 'Relay transfer
+    degradation'), pending retention is bounded by the window size, and
+    the drained window equals the eager computation."""
+    s = _solver("average_loss: 3")
+    assert s._loss_window.maxlen == 3
+
+    vals = [jnp.asarray([float(i)]) for i in range(10)]
+    for v in vals:
+        s.note_losses(v)
+    # lazy: nothing drained yet, retention bounded by maxlen
+    assert len(s._loss_window) == 0
+    assert len(s._pending_losses) == 3
+    # read drains; window = last maxlen values, mean is exact
+    assert s.smoothed_loss == pytest.approx((7 + 8 + 9) / 3)
+    assert len(s._pending_losses) == 0
+    assert list(s._loss_window) == [7.0, 8.0, 9.0]
+
+
+def test_note_losses_trainer_shape_takes_worker_mean():
+    """(workers, tau) trainer losses enter the window as the per-iter
+    worker mean (what the reference driver logs from what reaches it)."""
+    s = _solver("average_loss: 4")
+    arr = jnp.asarray(
+        [[1.0, 2.0, 3.0],
+         [3.0, 4.0, 5.0]]
+    )  # workers=2, tau=3 -> worker means [2, 3, 4]
+    s.note_losses(arr)
+    assert s.smoothed_loss == pytest.approx(3.0)
+    assert list(s._loss_window) == [2.0, 3.0, 4.0]
+
+
+def test_solver_step_keeps_loss_window_semantics():
+    """End to end: step() + smoothed_loss matches the eager per-iter
+    window average (solver.cpp:225-234 semantics) with the lazy path."""
+    s = _solver("average_loss: 2")
+    st = s.init_state(seed=0)
+    b = _batch()
+    batches = {k: np.stack([v, v, v]) for k, v in b.items()}  # tau=3
+    st, losses = s.step(st, batches)
+    got = s.smoothed_loss
+    want = float(np.mean(np.asarray(losses)[-2:]))
+    assert got == pytest.approx(want, rel=1e-6)
