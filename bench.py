@@ -410,9 +410,10 @@ def bench_hostfeed():
         return False
 
     # warm window: compile + the relay's once-per-program first-execute
-    # cost (minutes for a model this size)
+    # cost (minutes for a model this size).  solver.step is the public
+    # hot-loop API and is itself D2H-free (lazy note_losses).
     sample = next(pf)
-    state, losses = solver._jit_step(state, jax.device_put(sample), rng0)
+    state, losses = solver.step(state, jax.device_put(sample), rng0)
     warm_cap = float(os.environ.get("BENCH_WARM_CAP_S", "480"))
     warmed = drain_queue(losses, 15.0, warm_cap)
     print(
@@ -423,7 +424,7 @@ def bench_hostfeed():
     t0 = time.perf_counter()
     for _ in range(rounds):
         db = jax.device_put(next(pf))
-        state, losses = solver._jit_step(state, db, rng0)
+        state, losses = solver.step(state, db, rng0)
     # close the clock the same way (in-order queue: last round done ==
     # device idle); the probe itself is host->device only
     closed = drain_queue(losses, 0.05, 600.0)
@@ -440,17 +441,15 @@ def bench_hostfeed():
     lv = np.asarray(jax.device_get(losses))
     assert lv.shape == (tau,) and np.isfinite(lv).all(), lv
 
-    # legacy synced regime (round-4 protocol): device_get each round,
-    # staged puts — measured in the degraded mode the sync above left
-    # the relay in, which is exactly the regime it documents
+    # legacy synced regime (round-4 protocol): device_get per round,
+    # staged put — one round, measured in the degraded mode the sync
+    # above left the relay in, which is exactly the regime it documents
     t0 = time.perf_counter()
-    ab_rounds = 1
-    for _ in range(ab_rounds):
-        db = jax.device_put(next(pf))
-        jax.block_until_ready(db["data"])
-        state, losses = solver._jit_step(state, db, rng0)
-        float(np.asarray(jax.device_get(losses)).sum())
-    ab_synced_img_s = batch * tau * ab_rounds / (time.perf_counter() - t0)
+    db = jax.device_put(next(pf))
+    jax.block_until_ready(db["data"])
+    state, losses = solver.step(state, db, rng0)
+    float(np.asarray(jax.device_get(losses)).sum())
+    ab_synced_img_s = batch * tau / (time.perf_counter() - t0)
     pf.stop()
     pipe.close()
 

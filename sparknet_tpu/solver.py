@@ -30,6 +30,7 @@ from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from sparknet_tpu.config import load_net_prototxt
 from sparknet_tpu.config.schema import NetParameter, SolverParameter, solver_method
@@ -382,8 +383,6 @@ class Solver:
     def _drain_losses(self) -> None:
         if not self._pending_losses:
             return
-        import numpy as np
-
         pending, self._pending_losses = self._pending_losses, []
         for arr in pending:
             if getattr(arr, "ndim", 0) == 2:

@@ -33,20 +33,7 @@ def build(mutate=None, dtype="bfloat16"):
                   compute_dtype=None if dtype == "f32" else dtype)
 
 
-def drop_layers(netp, types):
-    """Remove layers of given types, rewiring bottoms (they're all in-place
-    or 1-in-1-out in AlexNet)."""
-    keep = []
-    rename = {}
-    for lp in netp.layer:
-        if lp.type in types:
-            # map top -> bottom
-            if list(lp.top) != list(lp.bottom):
-                rename[lp.top[0]] = lp.bottom[0]
-            continue
-        lp.bottom[:] = [rename.get(b, b) for b in lp.bottom]
-        keep.append(lp)
-    netp.layer[:] = keep
+from tools.deep_probe import drop_layers  # shared ablation helper
 
 
 def timeit(name, solver):
@@ -67,17 +54,16 @@ def timeit(name, solver):
     return dt
 
 
-timeit("baseline bf16", build())
-timeit("f32", build(dtype="f32"))
-timeit("no LRN", build(lambda p: drop_layers(p, {"LRN"})))
-timeit("no Dropout", build(lambda p: drop_layers(p, {"Dropout"})))
-timeit("no LRN+Dropout", build(lambda p: drop_layers(p, {"LRN", "Dropout"})))
-
-
 def ungroup(netp):
     for lp in netp.layer:
         if lp.type == "Convolution":
             lp.convolution_param.group = 1
 
 
-timeit("group=1 convs", build(ungroup))
+if __name__ == "__main__":
+    timeit("baseline bf16", build())
+    timeit("f32", build(dtype="f32"))
+    timeit("no LRN", build(lambda p: drop_layers(p, {"LRN"})))
+    timeit("no Dropout", build(lambda p: drop_layers(p, {"Dropout"})))
+    timeit("no LRN+Dropout", build(lambda p: drop_layers(p, {"LRN", "Dropout"})))
+    timeit("group=1 convs", build(ungroup))

@@ -140,6 +140,7 @@ def run_avg(Xtr, Ytr, test_batches, ntb, total_iters, log):
 
 def run_allreduce(Xtr, Ytr, test_batches, ntb, total_iters, log):
     """dp=8 synchronous gradient allreduce: global batch 8B."""
+    import jax
     import numpy as np
 
     from sparknet_tpu.parallel import AllReduceTrainer
@@ -162,8 +163,6 @@ def run_allreduce(Xtr, Ytr, test_batches, ntb, total_iters, log):
             state, {"data": Xtr[idx], "label": Ytr[idx]}
         )
         if (r + 1) % 5 == 0 or r == steps // chunk - 1:
-            import jax
-
             host = jax.tree_util.tree_map(lambda b: np.asarray(b), state)
             acc = _eval_acc(solver, host, test_batches, ntb)
             log.log(
