@@ -22,6 +22,15 @@ Modes (env):
                         harness for the >=0.9 linear-scaling target,
                         ``caffe/docs/multigpu.md:23-27``); run on a pod
                         slice it sweeps real devices
+  BENCH_MODE=serve      closed-loop inference serving load test through
+                        sparknet_tpu/serve (dynamic micro-batching):
+                        BENCH_CLIENTS concurrent clients, single-image
+                        requests, reports img/s + p50/p95/p99 latency +
+                        batch occupancy + the no-recompile invariant
+                        (SERVE_r06.json artifact)
+
+Modes can also be selected as ``python bench.py --mode=serve`` (flag
+wins over the env var).
   BENCH_PROFILE=1       also print the `caffe time`-style per-layer table
                         (stderr)
   BENCH_DTYPE=float32   reference numerics (default bfloat16 compute with
@@ -40,6 +49,14 @@ if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
 _MODE = os.environ.get("BENCH_MODE", "train")
+for _i, _a in enumerate(sys.argv[1:], start=1):
+    if _a.startswith("--mode="):
+        _MODE = _a.split("=", 1)[1]
+    elif _a == "--mode":
+        if _i + 1 >= len(sys.argv):
+            sys.exit("bench.py: --mode needs a value "
+                     "(train|hostfeed|scaling|serve)")
+        _MODE = sys.argv[_i + 1]
 if _MODE == "scaling":
     # the sweep needs >1 device; on a 1-chip host force the virtual CPU
     # mesh (the driver's multichip validation environment).  This must run
@@ -644,12 +661,134 @@ def bench_scaling():
     print(json.dumps(out))
 
 
+def bench_serve():
+    """Serving throughput/latency through the dynamic micro-batcher
+    (sparknet_tpu/serve): BENCH_CLIENTS closed-loop client threads each
+    fire BENCH_REQUESTS single-image ``submit``s back to back, so
+    concurrency — not request batching by the client — is what fills
+    buckets.  Reports end-to-end img/s, p50/p95/p99 request latency,
+    mean batch occupancy, and the no-recompile invariant (jit cache size
+    before == after the load).  HTTP is deliberately outside the loop:
+    this measures the batching engine; the stdlib front-end adds
+    parse/serialize cost that tests/test_serve_server.py covers
+    functionally."""
+    import threading
+
+    import numpy as np
+
+    from sparknet_tpu import models
+    from sparknet_tpu.serve import InferenceEngine, MicroBatcher
+
+    model = os.environ.get("BENCH_MODEL", "caffenet")
+    clients = int(os.environ.get("BENCH_CLIENTS", "16"))
+    per_client = int(os.environ.get("BENCH_REQUESTS", "64"))
+    buckets = [
+        int(b)
+        for b in os.environ.get("BENCH_BUCKETS", "1,4,16,64").split(",")
+    ]
+    max_wait_ms = float(os.environ.get("BENCH_MAX_WAIT_MS", "2.0"))
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+    if dtype in ("float32", "f32", "none"):
+        dtype = None
+
+    img, _nclass = _MODEL_SHAPES[model]
+    netp = models.deploy_variant(models.load_model(model), batch=buckets[-1])
+    engine = InferenceEngine(netp, buckets=buckets, compute_dtype=dtype)
+    t0 = time.perf_counter()
+    cache_after_warmup = engine.warmup()
+    warmup_s = time.perf_counter() - t0
+    print(
+        "serve warmup: %d bucket programs %s in %.1fs"
+        % (cache_after_warmup, engine.buckets, warmup_s),
+        file=sys.stderr,
+    )
+
+    batcher = MicroBatcher(
+        engine, max_queue=max(256, clients * 2), max_wait_ms=max_wait_ms
+    )
+    rng = np.random.RandomState(0)
+    x = rng.randn(*img).astype(np.float32)
+
+    # pre-load warm pass (fills the latency reservoir with steady-state
+    # shapes; not timed)
+    batcher.submit(x)
+
+    errors = []
+
+    def client():
+        try:
+            for _ in range(per_client):
+                batcher.submit(x, timeout=300.0)
+        except BaseException as e:  # pragma: no cover - surfaced below
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=client) for _ in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    assert not errors, errors[:3]
+    cache_after_load = engine.jit_cache_size()
+
+    total = clients * per_client
+    img_s = total / elapsed
+    lat = batcher.m_latency
+    occupancy = batcher.m_occupancy.mean()
+    batches = int(batcher.m_batches.value) - 1  # minus the warm pass
+    batcher.stop()
+
+    import jax
+
+    dev = jax.devices()[0]
+    p50, p95, p99 = (lat.quantile(q) for q in (0.50, 0.95, 0.99))
+    print(
+        "serve: %d clients x %d reqs -> %.1f img/s | p50 %.1f ms p95 "
+        "%.1f ms p99 %.1f ms | occupancy %.2f over %d batches | jit "
+        "cache %d -> %d"
+        % (
+            clients, per_client, img_s, p50 * 1e3, p95 * 1e3, p99 * 1e3,
+            occupancy, batches, cache_after_warmup, cache_after_load,
+        ),
+        file=sys.stderr,
+    )
+    out = {
+        "metric": "%s_serve_images_per_sec" % model,
+        "value": round(img_s, 1),
+        "unit": "img/s",
+        "vs_baseline": round(
+            img_s / _MODEL_BASELINE_IMG_S.get(model, BASELINE_IMG_S), 3
+        ),
+        "chip": dev.device_kind,
+        "p50_latency_ms": round(p50 * 1e3, 2),
+        "p95_latency_ms": round(p95 * 1e3, 2),
+        "p99_latency_ms": round(p99 * 1e3, 2),
+        "batch_occupancy_mean": round(occupancy, 4),
+        "batches": batches,
+        "requests": total,
+        "clients": clients,
+        "buckets": engine.buckets,
+        "max_wait_ms": max_wait_ms,
+        "recompiles_after_warmup": cache_after_load - cache_after_warmup,
+        "warmup_s": round(warmup_s, 1),
+        "note": "closed-loop load through MicroBatcher.submit (single-"
+        "image requests; concurrency fills buckets); latency is submit-"
+        "to-result per request; recompiles_after_warmup must be 0 — the "
+        "bucketed static-shape contract",
+    }
+    print(json.dumps(out))
+
+
 def main():
     if _MODE == "scaling":
         bench_scaling()
         return
     if _MODE == "hostfeed":
         bench_hostfeed()
+        return
+    if _MODE == "serve":
+        bench_serve()
         return
     # the remote-TPU tunnel occasionally drops a request mid-run; one
     # retry keeps the recorded benchmark from dying on a transient

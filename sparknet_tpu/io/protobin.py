@@ -25,8 +25,14 @@ Codec rules:
 - V0-era nets (nested ``layer`` connection messages inside ``layers``)
   decode to prototext token dicts and run the shared V0 upgrade
   (``UpgradeV0Net`` analog: padding-layer folding + per-field routing);
-- fields with no schema counterpart (layer ``blobs`` weights) raise with
-  guidance rather than silently dropping data.
+- layer ``blobs`` weights decode through the BlobProto schema and ride
+  through the upgrade passes in place (upgrade_proto.cpp:21-80 copies
+  them the same way);
+- BlobProto ``double_data``/``double_diff`` (fields 8/9) fold into the
+  float ``data``/``diff`` lists on read — the schema keeps one f32
+  precision, so double-precision weight files load losslessly-enough
+  instead of decoding to empty blobs (encode always writes float
+  ``data``, like the reference's upgrade output).
 """
 
 from __future__ import annotations
@@ -126,8 +132,15 @@ def decode(proto_msg: str, data: bytes):
                 "V0-era connection message outside a NetParameter "
                 "context; decode the whole net via load_net_binary"
             )
+        if proto_msg == "BlobProto" and name in (
+            "double_data", "double_diff"
+        ):
+            # fold double-precision payloads into the f32 data/diff
+            # lists (field 8 -> 5, 9 -> 6 semantics) rather than
+            # silently dropping them
+            name = "data" if name == "double_data" else "diff"
         if name not in ftypes:
-            continue  # e.g. BlobProto double_data
+            continue  # field with no schema counterpart
         # V1 'param' is the legacy share-name string list -> ParamSpec
         if proto_msg == "V1LayerParameter" and name == "param":
             obj.param = list(obj.param) + [

@@ -30,6 +30,19 @@ try:
 except AttributeError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
+try:
+    _pcast = lax.pcast  # jax >= 0.7: the varying-type system
+    _SHMAP_KW = {}
+except AttributeError:  # pragma: no cover - version-dependent
+    def _pcast(x, axis_name, to="varying"):
+        # pre-varying jax has no replication typing to satisfy; the
+        # loop-carry semantics are identical without the annotation
+        return x
+
+    # pre-varying shard_map mis-types the ppermute loop carries under
+    # autodiff (replication checker, not semantics) — disable the check
+    _SHMAP_KW = {"check_rep": False}
+
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     """Attention over ring-sharded KV. Call under shard_map; q/k/v are the
@@ -69,9 +82,9 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
 
     # carries must be typed as varying over the ring axis from the start
     # (the loop body makes them so) — pcast marks the replicated zeros
-    acc = lax.pcast(jnp.zeros((b, h, tq, d), q.dtype), axis_name, to="varying")
-    m = lax.pcast(jnp.full((b, h, tq), -jnp.inf, q.dtype), axis_name, to="varying")
-    l = lax.pcast(jnp.zeros((b, h, tq), q.dtype), axis_name, to="varying")
+    acc = _pcast(jnp.zeros((b, h, tq, d), q.dtype), axis_name, to="varying")
+    m = _pcast(jnp.full((b, h, tq), -jnp.inf, q.dtype), axis_name, to="varying")
+    l = _pcast(jnp.zeros((b, h, tq), q.dtype), axis_name, to="varying")
     # n-1 rotate-and-accumulate steps, then the last shard accumulates
     # without the (discarded) final exchange
     acc, m, l, k_last, v_last = lax.fori_loop(
@@ -95,6 +108,7 @@ def ring_self_attention(
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        **_SHMAP_KW,
     )
     def fn(q, k, v):
         return ring_attention(q, k, v, axis, causal=causal)

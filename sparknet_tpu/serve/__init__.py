@@ -1,0 +1,28 @@
+"""TPU-native inference serving: engine + dynamic micro-batching + HTTP.
+
+The serving L-layer over the training framework (ARCHITECTURE.md): a
+trained net (zoo name or prototxt, ``.caffemodel`` or snapshot weights)
+becomes a high-throughput request-serving engine.
+
+- ``engine.InferenceEngine``  — deploy-net loader; pre-compiles jitted
+  forward fns for a fixed set of static batch-size buckets so no XLA
+  recompile ever happens after warmup; weights stay device-resident.
+- ``batcher.MicroBatcher``    — bounded admission queue that coalesces
+  concurrent requests into the largest ready bucket under a max-wait
+  deadline (pad-and-mask static shapes), then demuxes per-request.
+- ``server.ServeServer``      — stdlib-only HTTP front-end: ``/predict``,
+  ``/healthz``, ``/metrics``; 429 load-shedding on queue overflow and
+  graceful drain on SIGTERM (``utils/signals.py``).
+- ``metrics``                 — counters/gauges/histograms rendered in
+  Prometheus text format.
+"""
+
+from sparknet_tpu.serve.batcher import MicroBatcher, QueueFull  # noqa: F401
+from sparknet_tpu.serve.engine import InferenceEngine  # noqa: F401
+from sparknet_tpu.serve.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from sparknet_tpu.serve.server import ServeServer  # noqa: F401

@@ -1,0 +1,260 @@
+"""Stdlib HTTP front-end for the inference engine.
+
+``http.server.ThreadingHTTPServer`` (one thread per connection) over a
+shared ``MicroBatcher`` — handler threads block in ``submit`` while the
+worker coalesces their requests into one forward pass, which is exactly
+the concurrency the micro-batcher feeds on.
+
+Endpoints:
+  POST /predict   body {"data": <nested list, (n,C,H,W) or (C,H,W)>}
+                  -> {"outputs": [...], "shape": [...], "batched": n}
+                  429 when the admission queue is full (load shedding),
+                  503 while draining, 400 on malformed input.
+  GET  /healthz   {"status": "ok"} | 503 {"status": "draining"}
+  GET  /metrics   Prometheus text format (serve/metrics.py)
+
+Graceful drain: SIGTERM/SIGINT (via ``utils/signals.py`` SignalHandler)
+flips /healthz to 503 (LB takes the replica out of rotation), stops
+admitting new work, serves everything queued, then shuts the listener
+down.
+"""
+
+from __future__ import annotations
+
+import json
+import signal as _signal
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from sparknet_tpu.serve.batcher import MicroBatcher, QueueFull
+from sparknet_tpu.serve.engine import InferenceEngine
+from sparknet_tpu.utils.signals import SignalHandler, SolverAction
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # set per-server via the factory in ServeServer
+    server_ctx: "ServeServer"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # route access logs to the app
+        if self.server_ctx.verbose:
+            print("serve: " + fmt % args)
+
+    # ------------------------------------------------------------------
+    def _send(self, code: int, payload: bytes, ctype: str,
+              extra_headers=()) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        for k, v in extra_headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _send_json(self, code: int, obj, extra_headers=()) -> None:
+        self._send(
+            code, json.dumps(obj).encode("utf-8"), "application/json",
+            extra_headers,
+        )
+
+    # ------------------------------------------------------------------
+    def do_GET(self):
+        ctx = self.server_ctx
+        if self.path == "/healthz":
+            if ctx.draining:
+                self._send_json(503, {"status": "draining"})
+            else:
+                self._send_json(200, {"status": "ok"})
+        elif self.path == "/metrics":
+            self._send(
+                200,
+                ctx.metrics.render().encode("utf-8"),
+                "text/plain; version=0.0.4",
+            )
+        else:
+            self._send_json(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        ctx = self.server_ctx
+        # ALWAYS consume the body first: early returns that leave it
+        # unread corrupt HTTP/1.1 keep-alive connections (the leftover
+        # bytes parse as the next request line)
+        try:
+            length = int(self.headers.get("Content-Length", "0") or 0)
+        except ValueError:
+            length = 0
+        raw = self.rfile.read(length) if length > 0 else b""
+        if self.path != "/predict":
+            self._send_json(404, {"error": f"no route {self.path}"})
+            return
+        if ctx.draining:
+            self._send_json(503, {"status": "draining"})
+            return
+        try:
+            body = json.loads(raw or b"{}")
+            x = np.asarray(body["data"], np.float32)
+        except (ValueError, KeyError, TypeError) as e:
+            self._send_json(400, {"error": f"bad request body: {e}"})
+            return
+        item_ndim = len(ctx.engine.item_shape)
+        if x.ndim == item_ndim + 1 and x.shape[0] == 0:
+            self._send_json(400, {"error": "empty batch"})
+            return
+        if x.ndim not in (item_ndim, item_ndim + 1) or (
+            tuple(x.shape[-item_ndim:]) != ctx.engine.item_shape
+        ):
+            self._send_json(
+                400,
+                {
+                    "error": "input shape %s does not match net input %s"
+                    % (list(x.shape), list(ctx.engine.item_shape))
+                },
+            )
+            return
+        try:
+            out = ctx.batcher.submit(x, timeout=ctx.request_timeout_s)
+        except QueueFull:
+            self._send_json(
+                429,
+                {"error": "queue full, retry later"},
+                extra_headers=[("Retry-After", "1")],
+            )
+            return
+        except TimeoutError as e:
+            self._send_json(504, {"error": str(e)})
+            return
+        except RuntimeError as e:
+            # only an actual drain is a 503; anything else (engine
+            # errors surface as RuntimeError subclasses, e.g.
+            # XlaRuntimeError) must NOT masquerade as one — the LB
+            # would keep routing while operators chase a phantom drain
+            if ctx.draining:
+                self._send_json(503, {"status": "draining"})
+            else:
+                self._send_json(500, {"error": f"inference failed: {e}"})
+            return
+        except Exception as e:  # noqa: BLE001 — a response beats a hang
+            self._send_json(500, {"error": f"inference failed: {e}"})
+            return
+        self._send_json(
+            200,
+            {
+                "outputs": out.tolist(),
+                "shape": list(out.shape),
+                "batched": int(x.shape[0]) if x.ndim == item_ndim + 1 else 1,
+            },
+        )
+
+
+class ServeServer:
+    """Engine + micro-batcher + HTTP listener, with signal-driven drain.
+
+    ``run()`` blocks until SIGTERM/SIGINT (must be called from the main
+    thread — CPython restricts signal handler installation); tests drive
+    the same lifecycle with ``start()`` / ``initiate_drain()`` /
+    ``shutdown()`` instead.
+    """
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        host: str = "127.0.0.1",
+        port: int = 8361,
+        max_queue: int = 256,
+        max_wait_ms: float = 2.0,
+        request_timeout_s: float = 60.0,
+        verbose: bool = False,
+    ):
+        self.engine = engine
+        self.batcher = MicroBatcher(
+            engine, max_queue=max_queue, max_wait_ms=max_wait_ms
+        )
+        self.metrics = self.batcher.metrics
+        self.request_timeout_s = float(request_timeout_s)
+        self.verbose = verbose
+        self._drain_evt = threading.Event()
+
+        ctx = self
+
+        class BoundHandler(_Handler):
+            server_ctx = ctx
+
+        self.httpd = ThreadingHTTPServer((host, port), BoundHandler)
+        self.httpd.daemon_threads = True
+        self._serve_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    @property
+    def address(self):
+        """(host, port) actually bound (port 0 resolves here)."""
+        return self.httpd.server_address[:2]
+
+    @property
+    def draining(self) -> bool:
+        return self._drain_evt.is_set() or self.batcher.draining
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        """Start serving on a background thread (non-blocking)."""
+        self._serve_thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            name="serve-http",
+            daemon=True,
+        )
+        self._serve_thread.start()
+
+    def initiate_drain(self) -> None:
+        """Flip health to 503 + stop admissions; in-flight and queued
+        requests still complete."""
+        self._drain_evt.set()
+        self.batcher.drain()
+
+    def shutdown(self, drain_timeout_s: float = 30.0) -> None:
+        """Drain the queue, stop the batcher worker, close the listener."""
+        self.initiate_drain()
+        deadline = time.perf_counter() + drain_timeout_s
+        while (
+            self.batcher.queue_depth() > 0
+            and time.perf_counter() < deadline
+        ):
+            time.sleep(0.02)
+        self.batcher.stop(drain=True, timeout=drain_timeout_s)
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._serve_thread is not None:
+            self._serve_thread.join(5.0)
+
+    # ------------------------------------------------------------------
+    def run(self, poll_s: float = 0.2) -> int:
+        """Blocking serve loop with signal-driven graceful drain
+        (SIGTERM and SIGINT -> STOP via utils/signals.py)."""
+        handler = SignalHandler(
+            sigint_effect=SolverAction.STOP,
+            sighup_effect=SolverAction.NONE,
+            sigterm_effect=SolverAction.STOP,
+        )
+        self.start()
+        host, port = self.address
+        print(f"serving on http://{host}:{port} (SIGTERM drains)")
+        try:
+            while True:
+                if handler.get_action() == SolverAction.STOP:
+                    print("serve: stop signal — draining")
+                    break
+                time.sleep(poll_s)
+        finally:
+            self.shutdown()
+            handler.restore()
+        print("serve: drained and shut down")
+        return 0
+
+    # convenience used by tests/bench: emulate SIGTERM delivery
+    def send_sigterm_to_self(self) -> None:
+        import os
+
+        os.kill(os.getpid(), _signal.SIGTERM)

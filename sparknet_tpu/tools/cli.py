@@ -9,6 +9,7 @@ device_query; flag semantics preserved where they make sense on TPU:
         [--iterations=50] [--allow_synthetic]
     python -m sparknet_tpu.tools.cli time --model=N [--iterations=50]
     python -m sparknet_tpu.tools.cli device_query
+    python -m sparknet_tpu.tools.cli serve --net=N [--weights=F] [--port=P]
 
 ``--gpu=...`` becomes ``--devices=N`` (first N local TPU devices as the dp
 mesh; the P2PSync role is AllReduceTrainer).  ``test`` scores real data:
@@ -467,6 +468,45 @@ def cmd_classify(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """``serve --net D.prototxt|zoo-name [--weights W] [--port P]
+    [--buckets 1,4,16,64] [--max_wait_ms 2] [--queue 256]`` — run the
+    inference serving front-end (``sparknet_tpu/serve/``): jitted
+    forward pre-compiled per batch bucket, dynamic micro-batching,
+    ``/predict`` + ``/healthz`` + ``/metrics``, SIGTERM graceful
+    drain."""
+    from sparknet_tpu import config, models
+    from sparknet_tpu.serve import InferenceEngine, ServeServer
+
+    netp = (
+        config.load_net_prototxt(args.net)
+        if args.net.endswith(".prototxt")
+        else models.load_model(args.net)
+    )
+    buckets = [int(b) for b in args.buckets.split(",") if b.strip()]
+    engine = InferenceEngine(
+        netp,
+        weights=args.weights,
+        buckets=buckets,
+        output_blob=args.output_blob,
+        compute_dtype=args.dtype or None,
+    )
+    n = engine.warmup()
+    print(
+        f"serve: warmed {n} bucket programs {engine.buckets} for "
+        f"input {engine.item_shape}, output blob {engine.output_blob!r}"
+    )
+    server = ServeServer(
+        engine,
+        host=args.host,
+        port=args.port,
+        max_queue=args.queue,
+        max_wait_ms=args.max_wait_ms,
+        verbose=args.verbose,
+    )
+    return server.run()
+
+
 def cmd_parse_log(args) -> int:
     """``parse_log LOG [--out PREFIX]`` — training log -> train/test
     CSVs (the ``tools/extra/parse_log.py`` role, for this framework's
@@ -737,6 +777,27 @@ def main(argv=None) -> int:
                    help="write N siamese 2-channel pairs instead")
     p.add_argument("--seed", type=int, default=0)
     p.set_defaults(fn=cmd_convert_mnist)
+
+    p = sub.add_parser("serve")
+    p.add_argument("--net", required=True,
+                   help="deploy prototxt or zoo model name")
+    p.add_argument("--weights", default=None,
+                   help=".caffemodel / .caffemodel.h5 (snapshot output ok)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8361)
+    p.add_argument("--buckets", default="1,4,16,64",
+                   help="comma-separated batch-size buckets to pre-compile")
+    p.add_argument("--max_wait_ms", type=float, default=2.0,
+                   help="micro-batch coalescing deadline")
+    p.add_argument("--queue", type=int, default=256,
+                   help="admission queue bound (overflow -> 429)")
+    p.add_argument("--output_blob", default=None,
+                   help="blob to serve (default: prob, else last top)")
+    p.add_argument("--dtype", default=None,
+                   help="compute dtype, e.g. bfloat16 (default f32)")
+    p.add_argument("--verbose", action="store_true",
+                   help="log each HTTP request")
+    p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("parse_log")
     p.add_argument("log")

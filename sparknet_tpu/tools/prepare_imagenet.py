@@ -137,11 +137,20 @@ def nested_tar_reader(
 ) -> Callable[[str], bytes]:
     """Fetch members of a tar-of-subtars by ``<subtar-stem>/<image>``
     via the offset index (built here if not supplied); bytes are read
-    on demand through one kept-open handle, so memory stays flat."""
+    on demand through one kept-open descriptor, so memory stays flat.
+
+    Reads use ``os.pread`` on a stored fd: the offset rides in the call
+    (no shared seek cursor), so one reader is safe to share across
+    threads — a seek+read pair on a shared handle interleaves under
+    concurrency and returns bytes from the wrong member.  The fd is
+    closed by a finalizer on the returned callable (no leak when the
+    reader is dropped)."""
+    import weakref
+
     if index is None:
         index = build_tar_index(path)
     by_basename = {os.path.basename(k): k for k in index}
-    fh = open(path, "rb")
+    fd = os.open(path, os.O_RDONLY)
 
     def read(name: str) -> bytes:
         entry = index.get(name)
@@ -152,9 +161,15 @@ def nested_tar_reader(
                 raise KeyError(name)
             entry = index[key]
         off, size = entry
-        fh.seek(off)
-        return fh.read(size)
+        buf = os.pread(fd, size, off)  # atomic at-offset read
+        if len(buf) != size:
+            raise IOError(
+                f"{path}: short read for {name!r} "
+                f"({len(buf)}/{size} bytes at {off})"
+            )
+        return buf
 
+    weakref.finalize(read, os.close, fd)
     return read
 
 

@@ -4,7 +4,11 @@ Reference: ``caffe/src/caffe/util/signal_handler.cpp:9-60`` + the solver's
 per-iteration action poll (``solver.cpp:267-280``) and the CLI flags
 ``--sigint_effect/--sighup_effect`` (tools/caffe.cpp:43-46).  SIGINT
 defaults to STOP, SIGHUP to SNAPSHOT; handlers only set flags — the driver
-polls between rounds (never mid-jit).
+polls between rounds (never mid-jit).  The serving front-end
+(``serve/server.py``) reuses the same poll-a-flag discipline with
+``sigterm_effect=STOP`` for graceful drain (SIGTERM is the orchestrator's
+shutdown signal; training ignores it by default, preserving the
+reference CLI's surface).
 """
 
 from __future__ import annotations
@@ -25,6 +29,7 @@ class SignalHandler:
         self,
         sigint_effect: SolverAction = SolverAction.STOP,
         sighup_effect: SolverAction = SolverAction.SNAPSHOT,
+        sigterm_effect: SolverAction = SolverAction.NONE,
     ):
         self._effects = {}
         self._flags = {SolverAction.STOP: False, SolverAction.SNAPSHOT: False}
@@ -32,6 +37,7 @@ class SignalHandler:
         for sig, effect in (
             (signal.SIGINT, sigint_effect),
             (signal.SIGHUP, sighup_effect),
+            (signal.SIGTERM, sigterm_effect),
         ):
             if effect != SolverAction.NONE:
                 self._effects[sig] = effect

@@ -63,6 +63,60 @@ def test_hostfeed_mode_smoke(hostcrop):
     assert rec["mode"] == (
         "u8_hostcrop" if hostcrop == "1" else "u8_fullframe_devicecrop"
     )
+    # the clock-validity flag must ride in every fresh artifact, and a
+    # CPU smoke must always close its clock cleanly — asserted WITHOUT
+    # a default (the committed-artifact pin below can only go strict
+    # once the r05 artifact is regenerated on the chip)
+    assert rec["clock_ok"] is True
+
+
+@pytest.mark.slow
+def test_serve_mode_smoke():
+    rec = _run_bench({
+        "BENCH_MODE": "serve", "BENCH_MODEL": "cifar10_full",
+        "BENCH_CLIENTS": "6", "BENCH_REQUESTS": "8",
+        "BENCH_BUCKETS": "1,4,8",
+    })
+    assert rec["metric"] == "cifar10_full_serve_images_per_sec"
+    assert rec["value"] > 0
+    assert rec["requests"] == 48
+    assert rec["p50_latency_ms"] > 0
+    assert rec["p50_latency_ms"] <= rec["p95_latency_ms"] <= (
+        rec["p99_latency_ms"]
+    )
+    assert 0 < rec["batch_occupancy_mean"] <= 1.0
+    # the serving contract: zero XLA recompiles once warmed
+    assert rec["recompiles_after_warmup"] == 0
+    assert rec["buckets"] == [1, 4, 8]
+
+
+_SERVE_SCHEMA_KEYS = (
+    "metric", "value", "unit", "vs_baseline", "chip", "p50_latency_ms",
+    "p95_latency_ms", "p99_latency_ms", "batch_occupancy_mean", "batches",
+    "requests", "clients", "buckets", "max_wait_ms",
+    "recompiles_after_warmup",
+)
+
+
+def test_committed_serve_artifact_schema():
+    """SERVE_r06.json — the serving-mode committed artifact: validate
+    the full schema and the invariants that make the number meaningful
+    (a validly-bucketed run never recompiles; quantiles are ordered;
+    occupancy is a ratio)."""
+    with open(os.path.join(_REPO, "SERVE_r06.json")) as f:
+        d = json.load(f)
+    for key in _SERVE_SCHEMA_KEYS:
+        assert key in d, key
+    assert d["metric"].endswith("_serve_images_per_sec")
+    assert d["unit"] == "img/s"
+    assert d["value"] > 0
+    assert d["requests"] >= d["clients"] >= 1
+    assert 0 < d["p50_latency_ms"] <= d["p95_latency_ms"] <= (
+        d["p99_latency_ms"]
+    )
+    assert 0 < d["batch_occupancy_mean"] <= 1.0
+    assert d["recompiles_after_warmup"] == 0, d
+    assert sorted(d["buckets"]) == d["buckets"]
 
 
 def test_committed_hostfeed_artifact_beats_baseline():
@@ -79,9 +133,19 @@ def test_committed_hostfeed_artifact_beats_baseline():
     assert d["metric"] == "caffenet_hostfeed_images_per_sec"
     assert d["vs_baseline"] >= 1.0, d
     assert d["value"] >= 267.0, d
-    # the artifact predates the clock_ok field only if absent; when
-    # present it must be True (cap-hit measurements are invalid)
-    assert d.get("clock_ok", True) is True, d
+    # clock validity: the committed r05 artifact predates the clock_ok
+    # field (its note documents the same open/close-by-probe protocol,
+    # but the drained/cap-hit flag wasn't serialized yet), so strict
+    # presence can only be required after an on-chip regeneration —
+    # this box has no TPU, so r05 stays the best available measurement.
+    # What IS enforced now, without defaults: (a) fresh runs always
+    # carry the flag (test_hostfeed_mode_smoke asserts
+    # rec["clock_ok"] is True on a live run), and (b) if this artifact
+    # ever regenerates, a False or missing flag fails here.
+    if "clock_ok" in d:
+        assert d["clock_ok"] is True, d
+    else:
+        assert "idleness probing" in d["note"], d  # protocol documented
     # honest-mode fields ride along
     assert d["mode"] == "u8_hostcrop"
     assert d["host_pipeline_images_per_sec"] > d["value"] * 0.5

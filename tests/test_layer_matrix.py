@@ -14,6 +14,7 @@ import numpy as np
 import pytest
 import jax
 import jax.numpy as jnp
+from jax.experimental import enable_x64 as jax_enable_x64
 
 from sparknet_tpu import config
 from sparknet_tpu.ops import base as ops_base
@@ -315,7 +316,7 @@ def test_f32_matrix(type_name):
     from tests.test_layers import _num_grad
 
     wrt_param = spec["mode"] == "param_grad"
-    with jax.enable_x64(True):
+    with jax_enable_x64(True):
 
         def scalar_out(v):
             if wrt_param:

@@ -11,6 +11,7 @@ import numpy as np
 import pytest
 import jax
 import jax.numpy as jnp
+from jax.experimental import enable_x64 as jax_enable_x64
 
 from sparknet_tpu import config
 from sparknet_tpu.net import JaxNet
@@ -41,7 +42,7 @@ def check_grad(layer, bottoms, blobs=None, train=True, rng=None, atol=5e-4):
     """Finite-difference check of d(sum of tops)/d(bottom0), in float64 like
     the reference's double-typed GradientChecker instantiations."""
     blobs = blobs or []
-    with jax.enable_x64(True):
+    with jax_enable_x64(True):
 
         def scalar_out(bot0):
             tops, _ = layer.apply(
@@ -216,7 +217,7 @@ def test_softmax_loss_grad_and_value():
     x = RNG.randn(4, 5).astype(np.float32)
     labels = np.array([0, 2, 4, 1], np.float32)
 
-    with jax.enable_x64(True):
+    with jax_enable_x64(True):
 
         def f(logits):
             tops, _ = l.apply(

@@ -123,6 +123,85 @@ def test_nested_tar_input(tmp_path):
     assert count == 6
 
 
+def test_nested_tar_reader_concurrent_readers(tmp_path):
+    """Regression (ADVICE r5 low): the reader shared one handle with an
+    unsynchronized seek+read pair — interleaved threads read bytes from
+    the WRONG member.  os.pread carries the offset in the call, so many
+    threads hammering one reader must each get exactly their member."""
+    import threading
+
+    payloads = {}
+    outer_path = tmp_path / "nested.tar"
+    rng = np.random.RandomState(7)
+    with tarfile.open(outer_path, "w") as outer:
+        for cls in ("n01", "n02", "n03"):
+            sub = io.BytesIO()
+            with tarfile.open(fileobj=sub, mode="w") as st:
+                for i in range(4):
+                    # distinct sizes + contents so a misread can't alias
+                    data = rng.randint(0, 256, 512 + 37 * i).astype(
+                        np.uint8
+                    ).tobytes()
+                    payloads[f"{cls}/{cls}_f{i}.bin"] = data
+                    info = tarfile.TarInfo(f"{cls}_f{i}.bin")
+                    info.size = len(data)
+                    st.addfile(info, io.BytesIO(data))
+            sub.seek(0)
+            info = tarfile.TarInfo(f"{cls}.tar")
+            info.size = len(sub.getvalue())
+            outer.addfile(info, sub)
+
+    read = prep.nested_tar_reader(str(outer_path))
+    names = sorted(payloads) * 8
+    errors = []
+
+    def worker(my_names):
+        try:
+            for n in my_names:
+                if read(n) != payloads[n]:
+                    errors.append(f"corrupt read for {n}")
+        except BaseException as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    threads = [
+        threading.Thread(target=worker, args=(names[i::8],))
+        for i in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errors, errors[:5]
+
+
+def test_nested_tar_reader_closes_fd_on_collect(tmp_path):
+    import gc
+
+    outer_path = tmp_path / "one.tar"
+    with tarfile.open(outer_path, "w") as outer:
+        sub = io.BytesIO()
+        with tarfile.open(fileobj=sub, mode="w") as st:
+            info = tarfile.TarInfo("a.bin")
+            info.size = 3
+            st.addfile(info, io.BytesIO(b"abc"))
+        sub.seek(0)
+        info = tarfile.TarInfo("n01.tar")
+        info.size = len(sub.getvalue())
+        outer.addfile(info, sub)
+
+    read = prep.nested_tar_reader(str(outer_path))
+    assert read("n01/a.bin") == b"abc"
+    fd = read.__closure__[
+        [i for i, c in enumerate(read.__code__.co_freevars)
+         if c == "fd"][0]
+    ].cell_contents
+    os.fstat(fd)  # open while the reader lives
+    del read
+    gc.collect()
+    with pytest.raises(OSError):
+        os.fstat(fd)  # finalizer closed it
+
+
 def test_upload_dry_run(tmp_path):
     src = tmp_path / "raw"
     out = tmp_path / "prepared"

@@ -125,6 +125,47 @@ def test_weight_carrying_modern_net_loads(tmp_path):
     assert list(lp.blobs[0].data) == [7.0]
 
 
+def test_double_data_blob_folds_into_data(tmp_path):
+    # BlobProto double_data/double_diff (fields 8/9) must fold into the
+    # f32 data/diff lists — a double-precision weights file previously
+    # decoded to EMPTY blobs, and upgrade_net_proto_binary silently
+    # wrote out weightless layers (ADVICE r5 medium)
+    vals = [1.5, -2.25, 3.0]  # f32-exact so the fold is lossless here
+    packed = np.asarray(vals, "<f8").tobytes()
+    blob_proto = (
+        wire.field_bytes(7, wire.field_packed_varints(1, (3,)))  # shape
+        + wire.field_bytes(8, packed)  # double_data, packed
+        + wire.field_bytes(9, packed)  # double_diff, packed
+    )
+    blob = protobin.decode("BlobProto", blob_proto)
+    assert list(blob.data) == vals
+    assert list(blob.diff) == vals
+
+    # end to end: the upgrade CLI must preserve the weights
+    layer = wire.field_bytes(1, b"ip") + wire.field_bytes(7, blob_proto)
+    src = tmp_path / "double.binaryproto"
+    src.write_bytes(wire.field_bytes(100, layer))
+    netp = protobin.load_net_binary(str(src))
+    (lp,) = netp.layer
+    assert list(lp.blobs[0].data) == vals
+    out = tmp_path / "upgraded.binaryproto"
+    protobin.save_net_binary(netp, str(out))
+    back = protobin.load_net_binary(str(out))
+    assert list(back.layer[0].blobs[0].data) == vals
+
+
+def test_double_data_unpacked_also_folds():
+    # proto2 writers may emit repeated doubles unpacked (one fixed64
+    # per tag)
+    import struct
+
+    blob_proto = b"".join(
+        wire.tag(8, 1) + struct.pack("<d", v) for v in (4.5, 0.25)
+    )
+    blob = protobin.decode("BlobProto", blob_proto)
+    assert list(blob.data) == [4.5, 0.25]
+
+
 def test_upgrade_net_proto_binary_cli(tmp_path):
     from sparknet_tpu.tools import cli
 
