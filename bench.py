@@ -28,6 +28,14 @@ Modes (env):
                         requests, reports img/s + p50/p95/p99 latency +
                         batch occupancy + the no-recompile invariant
                         (SERVE_r06.json artifact)
+  BENCH_MODE=chaos      fault-tolerance proof (sparknet_tpu/runtime/
+                        chaos.py): the default seeded FaultPlan injects
+                        storage faults, a producer stall, a SIGHUP
+                        preemption, snapshot corruption and a dead dp
+                        worker into a cifar10_quick run on the virtual
+                        mesh; reports faults injected/survived, recovery
+                        latency and the loss band vs the no-fault
+                        baseline (CHAOS_r07.json artifact)
 
 Modes can also be selected as ``python bench.py --mode=serve`` (flag
 wins over the env var).
@@ -55,10 +63,10 @@ for _i, _a in enumerate(sys.argv[1:], start=1):
     elif _a == "--mode":
         if _i + 1 >= len(sys.argv):
             sys.exit("bench.py: --mode needs a value "
-                     "(train|hostfeed|scaling|serve)")
+                     "(train|hostfeed|scaling|serve|chaos)")
         _MODE = sys.argv[_i + 1]
-if _MODE == "scaling":
-    # the sweep needs >1 device; on a 1-chip host force the virtual CPU
+if _MODE in ("scaling", "chaos"):
+    # these modes need >1 device; on a 1-chip host force the virtual CPU
     # mesh (the driver's multichip validation environment).  This must run
     # BEFORE the first backend use (XLA_FLAGS is parsed once per process),
     # and must flip the live jax config — the axon tunnel pins
@@ -780,6 +788,57 @@ def bench_serve():
     print(json.dumps(out))
 
 
+def bench_chaos():
+    """Chaos-harness proof run (``runtime/chaos.py``): the default
+    seeded FaultPlan on the virtual CPU mesh.  The headline value is
+    faults survived; vs_baseline is survived/injected (done-bar 1.0).
+    BENCH_CHAOS_SEED overrides the plan seed (same fault schedule
+    structure, different data/backoff draws)."""
+    import dataclasses
+    import tempfile
+
+    import jax
+
+    from sparknet_tpu.runtime import chaos
+
+    plan = chaos.FaultPlan.default()
+    seed = os.environ.get("BENCH_CHAOS_SEED")
+    if seed is not None:
+        plan = dataclasses.replace(plan, seed=int(seed))
+    t0 = time.perf_counter()
+    # verbose=False: stdout carries ONLY the one-line JSON contract;
+    # the event log goes to stderr below
+    rep = chaos.run_chaos(
+        plan, workdir=tempfile.mkdtemp(prefix="bench_chaos_")
+    )
+    elapsed = time.perf_counter() - t0
+    events = rep.pop("events")
+    for e in events:
+        print("chaos: " + e, file=sys.stderr)
+    out = {
+        "metric": "chaos_faults_survived",
+        "value": rep["faults_survived"],
+        "unit": "faults",
+        "vs_baseline": round(
+            rep["faults_survived"] / max(1, rep["faults_injected"]), 3
+        ),
+        "platform": jax.devices()[0].platform,
+        "elapsed_s": round(elapsed, 1),
+        **{k: v for k, v in rep.items() if k not in ("value",)},
+        "note": "default seeded FaultPlan on the virtual CPU mesh: "
+        "transient storage faults healed by utils/retry, a producer "
+        "stall absorbed/recovered via the Prefetcher watchdog, a real "
+        "SIGHUP preemption + simulated process death, newest-snapshot "
+        "corruption quarantined with fallback to the newest CRC-valid "
+        "snapshot (io/checkpoint.restore_newest_valid), and one dead "
+        "dp worker masked out of the parameter average "
+        "(survivor-aware ParameterAveragingTrainer.round); "
+        "faults_survived must equal faults_injected and the final "
+        "loss must sit inside the no-fault run's band",
+    }
+    print(json.dumps(out))
+
+
 def main():
     if _MODE == "scaling":
         bench_scaling()
@@ -789,6 +848,9 @@ def main():
         return
     if _MODE == "serve":
         bench_serve()
+        return
+    if _MODE == "chaos":
+        bench_chaos()
         return
     # the remote-TPU tunnel occasionally drops a request mid-run; one
     # retry keeps the recorded benchmark from dying on a transient

@@ -18,7 +18,6 @@ Run:
 from __future__ import annotations
 
 import argparse
-import glob
 import json
 import os
 
@@ -140,16 +139,18 @@ def main(argv=None) -> int:
     prefix = args.snapshot_prefix or os.path.join(args.db_dir, "imagenet_db")
     start_round = 0
     if args.resume:
-        states = sorted(
-            glob.glob(prefix + "_iter_*.solverstate*"),
-            key=lambda p: int(p.split("_iter_")[1].split(".")[0]),
-        )
-        if not states:
+        # fault-tolerant resume: CRC-verified, newest-valid-wins — a
+        # corrupt/truncated newest snapshot (preemption mid-write) is
+        # quarantined and the scan falls back to an older valid one
+        try:
+            st, used = checkpoint.restore_newest_valid(solver, prefix)
+        except FileNotFoundError:
             raise SystemExit(f"--resume: no {prefix}_iter_*.solverstate*")
-        st = checkpoint.restore(solver, states[-1])
+        except checkpoint.SnapshotCorrupt as e:
+            raise SystemExit(f"--resume: {e}")
         state = _broadcast_state(trainer, st)
         start_round = int(np.asarray(st.iter)) // args.tau
-        log.log(f"resumed from {states[-1]} at round {start_round}")
+        log.log(f"resumed from {used} at round {start_round}")
     elif args.warm_start:
         # ImageNetRunDBApp.scala:75 loadWeightsFromFile
         st = checkpoint.load_weights_into_state(

@@ -29,9 +29,23 @@ from __future__ import annotations
 import html.parser
 import io
 import json
+import urllib.error
 import urllib.parse
 import urllib.request
-from typing import List
+from typing import Callable, List, Optional
+
+from sparknet_tpu.utils import retry as _retry
+
+# Chaos/test seam: when set, called with the URL at the START of every
+# fetch attempt (including retries) and may raise to simulate a storage
+# fault — the retry layer then heals it exactly as it would a real one.
+# Installed by ``runtime/chaos.py`` storage-fault injection.
+_fault_hook: Optional[Callable[[str], None]] = None
+
+
+def set_fault_hook(hook: Optional[Callable[[str], None]]) -> None:
+    global _fault_hook
+    _fault_hook = hook
 
 
 def is_object_store_url(root: str) -> bool:
@@ -63,9 +77,36 @@ class ObjectStore:
             return f.read()
 
 
-def _get(url: str, timeout: float = 60.0):
-    req = urllib.request.Request(url, headers={"User-Agent": "sparknet-tpu"})
-    return urllib.request.urlopen(req, timeout=timeout)
+def _get(
+    url: str,
+    timeout: float = 60.0,
+    policy: Optional[_retry.RetryPolicy] = None,
+):
+    """GET with retry/backoff (``utils/retry.py``): 5xx/429/timeouts/
+    connection-resets retry under the policy's budget; other 4xx
+    propagate immediately.  An ``HTTPError`` is itself a live response
+    object — it is drained and closed before classification so a failed
+    attempt never leaks a half-open socket into the next one."""
+
+    def attempt():
+        if _fault_hook is not None:
+            _fault_hook(url)
+        req = urllib.request.Request(
+            url, headers={"User-Agent": "sparknet-tpu"}
+        )
+        try:
+            return urllib.request.urlopen(req, timeout=timeout)
+        except urllib.error.HTTPError as e:
+            # the error IS the response: drain its (small) body and
+            # close the socket so a failed attempt leaks nothing
+            try:
+                e.read()
+            except OSError:
+                pass
+            e.close()
+            raise
+
+    return _retry.retry_call(attempt, policy=policy)
 
 
 class _SplitUrl:

@@ -12,18 +12,35 @@ COLLAPSE throughput (PERF.md "Tunnel transfer degradation"): pass
 ``device_put=False`` there and stage ``jax.device_put`` +
 ``block_until_ready`` on the consumer between steps, as
 ``bench.py bench_hostfeed`` does.
+
+Fault tolerance: ``stall_timeout_s`` arms a consumer-side watchdog — if
+the producer delivers nothing for that long (storage wedged past the
+retry layer's budget, dead pipeline thread), ``__next__`` raises
+``PrefetchStall`` instead of hanging the training loop forever; the
+driver can tear the prefetcher down (``stop()`` is idempotent and
+reports whether the thread actually died) and rebuild it — the pattern
+``runtime/chaos.py`` proves out.
 """
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
+import time
 from typing import Callable, Dict, Iterator, Optional
 
 import jax
 import numpy as np
 
 PREFETCH_COUNT = 3  # reference: data_layers.hpp PREFETCH_COUNT
+
+_log = logging.getLogger(__name__)
+
+
+class PrefetchStall(RuntimeError):
+    """The producer went silent past ``stall_timeout_s`` — the loop gets
+    a diagnosable error instead of an unbounded ``queue.get`` hang."""
 
 
 class Prefetcher:
@@ -36,23 +53,39 @@ class Prefetcher:
         depth: int = PREFETCH_COUNT,
         device_put: bool = True,
         sharding=None,
+        stall_timeout_s: Optional[float] = None,
     ):
         self._produce = produce
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._done = False
+        self._stopped = False
+        self._thread_exited: Optional[bool] = None
         self._error: Optional[BaseException] = None
         self._device_put = device_put
         self._sharding = sharding
+        self._stall_timeout_s = stall_timeout_s
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
+
+    def _put_politely(self, item) -> bool:
+        """Bounded-queue put that keeps checking the stop flag — the
+        producer must never block unkillably, not even on the final
+        ``None`` sentinel."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def _run(self):
         try:
             while not self._stop.is_set():
                 batch = self._produce()
                 if batch is None:
-                    self._q.put(None)
+                    self._put_politely(None)
                     return
                 if self._device_put:
                     batch = (
@@ -60,16 +93,10 @@ class Prefetcher:
                         if self._sharding is not None
                         else jax.device_put(batch)
                     )
-                # block politely so stop() can interrupt
-                while not self._stop.is_set():
-                    try:
-                        self._q.put(batch, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
+                self._put_politely(batch)
         except BaseException as e:  # surfaced on next __next__
             self._error = e
-            self._q.put(None)
+            self._put_politely(None)
 
     def __iter__(self) -> Iterator:
         return self
@@ -79,7 +106,20 @@ class Prefetcher:
             if self._error is not None:
                 raise self._error
             raise StopIteration
-        item = self._q.get()
+        if self._stall_timeout_s is None:
+            item = self._q.get()
+        else:
+            try:
+                item = self._q.get(timeout=self._stall_timeout_s)
+            except queue.Empty:
+                raise PrefetchStall(
+                    "prefetch producer delivered nothing for %.1fs "
+                    "(thread %s)"
+                    % (
+                        self._stall_timeout_s,
+                        "alive" if self._thread.is_alive() else "DEAD",
+                    )
+                ) from None
         if item is None:
             self._done = True  # sticky: keep raising after exhaustion/error
             if self._error is not None:
@@ -87,15 +127,34 @@ class Prefetcher:
             raise StopIteration
         return item
 
-    def stop(self):
+    def stop(self, timeout: float = 5.0) -> bool:
+        """Stop the producer and reap its thread.  Idempotent; returns
+        True iff the thread is actually dead (repeated calls return the
+        recorded outcome).  Drains the queue CONTINUOUSLY while joining —
+        a single drain pass lets a producer blocked in ``put`` re-fill
+        the queue and outlive the join."""
+        if self._stopped:
+            if self._thread_exited is False and not self._thread.is_alive():
+                self._thread_exited = True  # late exit after first report
+            return bool(self._thread_exited)
+        self._stopped = True
         self._stop.set()
-        # drain so the producer unblocks
-        try:
-            while True:
-                self._q.get_nowait()
-        except queue.Empty:
-            pass
-        self._thread.join(timeout=5)
+        deadline = time.monotonic() + timeout
+        while self._thread.is_alive() and time.monotonic() < deadline:
+            try:
+                while True:
+                    self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)
+        self._thread_exited = not self._thread.is_alive()
+        if not self._thread_exited:
+            _log.warning(
+                "Prefetcher.stop: producer thread still alive after "
+                "%.1fs (blocked in produce()?)",
+                timeout,
+            )
+        return self._thread_exited
 
 
 def device_prefetch(iterator, depth: int = 2, sharding=None):

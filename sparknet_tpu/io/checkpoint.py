@@ -15,12 +15,35 @@ reference snapshot formats are supported, chosen by
 
 ``snapshot()``/``restore()`` round-trip bitwise in either format; restore
 and warm-start detect the format from the file extension.
+
+Integrity + recovery (the fault-tolerance layer): every snapshot also
+publishes ``{prefix}_iter_{N}.manifest.json`` with the CRC32 and size of
+each file.  ``restore()`` verifies the manifest when present and raises
+``SnapshotCorrupt`` on mismatch; ``restore_newest_valid()`` walks
+snapshots newest-first, QUARANTINES corrupt/truncated ones (renamed with
+a ``.corrupt`` suffix so the next resume doesn't trip on them again) and
+falls back to the newest snapshot that verifies — preemption mid-write
+or bit-rot degrades to an older restore point instead of killing the
+resume (``imagenet_run_db_app --resume`` / ``cli train --resume``;
+chaos-proved by ``runtime/chaos.py``).
 """
 
 from __future__ import annotations
 
+import glob as _glob
+import json
+import logging
 import os
-from typing import Optional, Tuple
+import zlib
+from typing import List, Optional, Tuple
+
+_log = logging.getLogger(__name__)
+
+_STATE_SUFFIXES = (".solverstate.npz", ".solverstate.h5")
+
+
+class SnapshotCorrupt(RuntimeError):
+    """A snapshot failed CRC/size verification or could not be decoded."""
 
 import jax
 import numpy as np
@@ -44,6 +67,83 @@ def _atomic(write_fn, path: str) -> None:
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
+
+
+def _crc32_file(path: str) -> Tuple[int, int]:
+    """Streaming (crc32, size) of a file."""
+    crc = 0
+    size = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                return crc & 0xFFFFFFFF, size
+            crc = zlib.crc32(chunk, crc)
+            size += len(chunk)
+
+
+def manifest_path_for(path: str) -> str:
+    """``.../p_iter_N.<anything>`` -> ``.../p_iter_N.manifest.json``."""
+    base = path
+    for suf in _STATE_SUFFIXES + (".caffemodel.h5", ".caffemodel"):
+        if base.endswith(suf):
+            base = base[: -len(suf)]
+            break
+    return base + ".manifest.json"
+
+
+def _write_manifest(it: int, fmt: str, paths: Tuple[str, str]) -> str:
+    mpath = manifest_path_for(paths[1])
+    entries = {}
+    for p in paths:
+        crc, size = _crc32_file(p)
+        entries[os.path.basename(p)] = {"crc32": crc, "size": size}
+
+    def _dump(tmp):
+        with open(tmp, "w") as f:
+            json.dump(
+                {"iter": int(it), "format": fmt, "files": entries}, f
+            )
+
+    _atomic(_dump, mpath)
+    return mpath
+
+
+def verify_snapshot(state_path: str) -> None:
+    """CRC32/size-check every file the snapshot's manifest lists.
+    Raises ``SnapshotCorrupt`` on truncation/mismatch/missing files; a
+    snapshot with NO manifest (pre-manifest format) passes — decode
+    errors are still caught by ``restore_newest_valid``."""
+    mpath = manifest_path_for(state_path)
+    if not os.path.exists(mpath):
+        return
+    # OSError (transient I/O on flaky storage — the very environment
+    # this layer targets) propagates as-is: only DECODE failure of the
+    # manifest is evidence of corruption.  restore_newest_valid treats
+    # plain OSError as non-corruption and leaves the snapshot intact.
+    with open(mpath) as f:
+        raw = f.read()
+    try:
+        manifest = json.loads(raw)
+        files = manifest["files"]
+    except (ValueError, KeyError, TypeError) as e:
+        raise SnapshotCorrupt(f"{mpath}: unreadable manifest: {e}") from e
+    d = os.path.dirname(state_path)
+    for name, want in files.items():
+        p = os.path.join(d, name)
+        if not os.path.exists(p):
+            raise SnapshotCorrupt(f"{p}: listed in manifest but missing")
+        crc, size = _crc32_file(p)
+        if size != int(want["size"]):
+            raise SnapshotCorrupt(
+                f"{p}: truncated ({size} bytes, manifest says "
+                f"{want['size']})"
+            )
+        if crc != int(want["crc32"]):
+            raise SnapshotCorrupt(
+                f"{p}: CRC32 mismatch ({crc:#x} vs manifest "
+                f"{int(want['crc32']):#x})"
+            )
 
 
 def _write_snapshot(
@@ -81,6 +181,10 @@ def _write_snapshot(
                 )
 
         _atomic(_savez, state_path)
+    # manifest publishes LAST: a kill between the data files and here
+    # leaves a manifest-less (pre-format) snapshot, never a manifest
+    # that vouches for half-written data
+    _write_manifest(it, fmt, (model_path, state_path))
     return model_path, state_path
 
 
@@ -168,11 +272,20 @@ def _load_model_blobs(model_path: str):
     return caffemodel.load_weights(model_path)
 
 
-def restore(solver: Solver, prefix_or_state_path: str, seed: int = 0) -> TrainState:
+def restore(
+    solver: Solver,
+    prefix_or_state_path: str,
+    seed: int = 0,
+    verify: bool = True,
+) -> TrainState:
     """Rebuild a TrainState from a snapshot (``Solver::Restore`` +
     ``restore_solver_from_file``, ccaffe.cpp:271-273).  Accepts either a
-    ``.solverstate.npz`` or ``.solverstate.h5`` path."""
+    ``.solverstate.npz`` or ``.solverstate.h5`` path.  When the snapshot
+    carries a manifest, its CRC32s are checked first (``verify=False``
+    opts out, e.g. for forensics on a quarantined file)."""
     state_path = prefix_or_state_path
+    if verify:
+        verify_snapshot(state_path)
     fresh = solver.init_state(seed)
     leaves, treedef = _flatten_history(jax.device_get(fresh.history))
     if state_path.endswith(".solverstate.h5"):
@@ -202,6 +315,91 @@ def restore(solver: Solver, prefix_or_state_path: str, seed: int = 0) -> TrainSt
         stats=jax.device_put(stats),
         history=jax.device_put(history),
         iter=np.asarray(it, np.int32),
+    )
+
+
+def find_snapshots(prefix: str) -> List[str]:
+    """All non-quarantined solverstate paths for ``prefix``, sorted by
+    iteration ascending (the resume scan)."""
+    out = [
+        p
+        for p in _glob.glob(prefix + "_iter_*.solverstate*")
+        if p.endswith(_STATE_SUFFIXES)
+    ]
+    return sorted(out, key=lambda p: int(p.split("_iter_")[-1].split(".")[0]))
+
+
+def _quarantine(state_path: str) -> List[str]:
+    """Rename every file of a corrupt snapshot (model, state, manifest)
+    with a ``.corrupt`` suffix so resume scans skip it but forensics can
+    still read it."""
+    mpath = manifest_path_for(state_path)
+    for suf in _STATE_SUFFIXES:
+        if state_path.endswith(suf):
+            base = state_path[: -len(suf)]
+            break
+    else:  # pragma: no cover - callers always pass a state path
+        base = os.path.splitext(state_path)[0]
+    moved = []
+    for p in (
+        state_path,
+        base + ".caffemodel",
+        base + ".caffemodel.h5",
+        mpath,
+    ):
+        if os.path.exists(p):
+            os.replace(p, p + ".corrupt")
+            moved.append(p + ".corrupt")
+    return moved
+
+
+def restore_newest_valid(
+    solver: Solver,
+    prefix: str,
+    seed: int = 0,
+    quarantine: bool = True,
+) -> Tuple[TrainState, str]:
+    """Resume from the newest snapshot that VERIFIES — the fault-
+    tolerant ``--resume`` path.  Walks ``find_snapshots(prefix)`` newest
+    first; a snapshot that fails its manifest check or cannot be decoded
+    is quarantined (renamed ``*.corrupt``) and the scan falls back to
+    the next-older one.  Returns ``(state, state_path)``; raises
+    ``FileNotFoundError`` when no snapshots exist at all and
+    ``SnapshotCorrupt`` when every candidate is bad."""
+    import zipfile
+
+    candidates = find_snapshots(prefix)
+    if not candidates:
+        raise FileNotFoundError(f"no {prefix}_iter_*.solverstate* snapshots")
+    failures = []
+    for state_path in reversed(candidates):
+        try:
+            return restore(solver, state_path, seed=seed), state_path
+        except (ImportError, ModuleNotFoundError):
+            raise  # missing h5py etc: environment problem, not corruption
+        except Exception as e:  # noqa: BLE001 — classified below
+            failures.append(f"{state_path}: {e}")
+            # Quarantine ONLY evidence of file corruption: a failed
+            # manifest check, or (for manifest-less legacy snapshots) a
+            # truncated/garbage container.  Anything else — solver
+            # mismatch, transient I/O — is a caller/environment problem:
+            # renaming healthy snapshots for it would destroy the very
+            # restore points this function exists to protect.
+            is_corrupt = isinstance(
+                e, (SnapshotCorrupt, zipfile.BadZipFile, EOFError)
+            )
+            _log.warning(
+                "restore_newest_valid: skipping %s (%s)%s",
+                state_path,
+                e,
+                "; quarantining" if (quarantine and is_corrupt)
+                else "; left intact",
+            )
+            if quarantine and is_corrupt:
+                _quarantine(state_path)
+    raise SnapshotCorrupt(
+        "no valid snapshot under prefix %r; all %d candidates failed:\n%s"
+        % (prefix, len(candidates), "\n".join(failures))
     )
 
 

@@ -135,21 +135,35 @@ class ParameterAveragingTrainer:
         self.axis = axis
         self.num_workers = mesh.shape[axis]
 
-        def round_body(state, batches, rng):
+        def round_body(state, batches, rng, live):
             # shard_map hands each worker a leading axis of size 1
             st = tree_map(lambda x: x[0], state)
             bt = tree_map(lambda x: x[0], batches)
             widx = jax.lax.axis_index(axis)
             lrng = jax.random.fold_in(rng, widx)
             st, losses = solver._step_tau(st, bt, lrng)
-            # averaging round: params (and BN stats) only, never history
+            # averaging round: params (and BN stats) only, never history.
+            # Survivor-aware: the average is a masked weighted mean over
+            # LIVE workers — psum(where(live, theta, 0))/psum(live) — so
+            # a dead dp worker's replica is excluded instead of
+            # poisoning every survivor, and the dead slot itself is
+            # overwritten with the survivor mean (it rejoins healthy).
+            # where(), not multiplication: a dead replica holding
+            # NaN/Inf garbage (diverged or interrupted step) must not
+            # leak through 0*NaN=NaN into the psum.  With live == ones
+            # this is exactly psum(theta)/N, the original pmean.
+            alive = live[0]
+            denom = jnp.maximum(jax.lax.psum(alive, axis), 1.0)
+
+            def wmean(w):
+                contrib = jnp.where(alive > 0, w, jnp.zeros_like(w))
+                return jax.lax.psum(contrib, axis) / denom.astype(w.dtype)
+
             avg_params = (
-                tree_map(lambda w: jax.lax.pmean(w, axis), st.params)
-                if average_params
-                else st.params
+                tree_map(wmean, st.params) if average_params else st.params
             )
             avg_stats = (
-                tree_map(lambda w: jax.lax.pmean(w, axis), st.stats)
+                tree_map(wmean, st.stats)
                 if average_stats and average_params
                 else st.stats
             )
@@ -160,11 +174,12 @@ class ParameterAveragingTrainer:
             shard_map(
                 round_body,
                 mesh=mesh,
-                in_specs=(P(axis), P(axis), P()),
+                in_specs=(P(axis), P(axis), P(), P(axis)),
                 out_specs=(P(axis), P(axis)),
             ),
             donate_argnums=(0,),
         )
+        self._live_ones = None  # lazily-placed all-alive mask
 
         def eval_body(state, batches, counts):
             # heterogeneous partitions: every worker's batches are padded
@@ -213,11 +228,46 @@ class ParameterAveragingTrainer:
 
         return tree_map(mk, st)
 
-    def round(self, state: TrainState, batches: Dict[str, jax.Array], rng=None):
+    def _place_live(self, live_mask) -> jax.Array:
+        """Place a host (num_workers,) 0/1 mask over the dp axis."""
+        live = np.asarray(live_mask, np.float32).reshape(-1)
+        if live.shape[0] != self.num_workers:
+            raise ValueError(
+                f"live_mask has {live.shape[0]} entries, mesh has "
+                f"{self.num_workers} workers"
+            )
+        sharding = NamedSharding(self.mesh, P(self.axis))
+        if jax.process_count() > 1:
+            return jax.make_array_from_callback(
+                live.shape, sharding, lambda idx: live[idx]
+            )
+        return jax.device_put(live, sharding)
+
+    def round(
+        self,
+        state: TrainState,
+        batches: Dict[str, jax.Array],
+        rng=None,
+        live_mask=None,
+    ):
         """One averaging round: ``batches[blob]`` is (num_workers, tau, ...)
-        — worker-major, tau-deep.  Returns (state, losses (workers, tau))."""
+        — worker-major, tau-deep.  Returns (state, losses (workers, tau)).
+
+        ``live_mask`` (num_workers,) of 0/1 marks which dp workers
+        survive this round: dead workers are excluded from the average
+        (masked weighted mean) and receive the survivor mean — a lost
+        partition degrades throughput, never the weights.  ``None``
+        means all alive (identical numerics to the unmasked round)."""
         rng = rng if rng is not None else train_key(0)
-        state, losses = self._round(state, batches, rng)
+        if live_mask is None:
+            if self._live_ones is None:
+                self._live_ones = self._place_live(
+                    np.ones((self.num_workers,), np.float32)
+                )
+            live = self._live_ones
+        else:
+            live = self._place_live(live_mask)
+        state, losses = self._round(state, batches, rng, live)
         # recorded lazily: smoothed_loss pulls the worker-mean of the
         # addressable shards on read (Solver._drain_losses) — no
         # device->host sync in the round loop

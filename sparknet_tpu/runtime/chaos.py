@@ -1,0 +1,531 @@
+"""Deterministic chaos harness: prove the fault-tolerance layer end to end.
+
+SparkNet got fault tolerance for free from Spark's RDD lineage — a lost
+partition recomputed and the averaging loop never noticed (PAPER.md §2).
+The TPU rewrite has to EARN the same property, and this module is the
+proof: a seeded ``FaultPlan`` injects the defining failure modes of a
+real TPU pod into a small cifar10_quick run on the virtual mesh —
+
+- **storage faults**: transient connection-resets in the data fetch,
+  healed by ``utils/retry`` (the same layer ``data/object_store._get``
+  sits on),
+- **feed stalls**: the producer wedges past the ``Prefetcher`` stall
+  watchdog; the driver tears the prefetcher down (robust ``stop()``)
+  and rebuilds it,
+- **preemption**: a real SIGHUP delivered mid-run — snapshot, simulated
+  process death, resume,
+- **snapshot corruption**: the newest snapshot's bytes are flipped, so
+  resume must quarantine it and fall back to the newest VALID one
+  (``io/checkpoint.restore_newest_valid``),
+- **worker death**: one dp worker drops out mid-run; survivor-aware
+  averaging (``ParameterAveragingTrainer.round(live_mask=...)``) keeps
+  the weights healthy.
+
+Every fault is counted as injected and (when the run recovers) survived;
+``bench.py --mode=chaos`` emits the ``CHAOS_r07.json`` artifact
+(faults_injected, faults_survived, recovery latency, loss-band check
+against the no-fault baseline) and the tier-1 chaos smoke
+(``tests/test_chaos.py``) runs the same default plan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import signal as _signal
+import tempfile
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from sparknet_tpu.utils import retry as _retry
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """A seeded, fully-deterministic schedule of faults.
+
+    Rounds are 0-indexed and ABSOLUTE (replayed rounds after a resume
+    keep their original index, so per-round faults fire exactly once).
+    The default plan is the tier-1 chaos smoke: every fault class, small
+    shapes, < 1 min on a CPU box."""
+
+    seed: int = 7
+    workers: int = 4
+    rounds: int = 6
+    tau: int = 2
+    batch: int = 8
+    # round -> consecutive transient storage errors before that round's
+    # fetch succeeds (healed by the retry layer)
+    storage_faults: Tuple[Tuple[int, int], ...] = ((1, 2), (4, 1))
+    # rounds whose fetch stalls past the prefetch watchdog (fires once).
+    # Round 0 by default: the consumer has no prefetch-depth lead yet,
+    # so the watchdog deterministically fires and the
+    # stop()-and-rebuild recovery path is what survives the fault (a
+    # stall in a later round can be absorbed by the buffer instead —
+    # also a survival, just a less interesting one)
+    stall_rounds: Tuple[int, ...] = (0,)
+    stall_s: float = 4.0
+    stall_timeout_s: float = 1.0
+    # SIGHUP preemption at the END of this round (None = no preemption)
+    preempt_round: Optional[int] = 3
+    corrupt_newest: bool = True  # corrupt newest snapshot before resume
+    # this dp worker dies (drops from the average) from this round on
+    dead_worker: Optional[int] = 2
+    dead_from_round: int = 4
+    snapshot_every: int = 2  # periodic snapshot cadence, in rounds
+
+    @classmethod
+    def default(cls) -> "FaultPlan":
+        return cls()
+
+    def no_fault_view(self) -> "FaultPlan":
+        """The same run shape with every fault removed (the baseline)."""
+        return dataclasses.replace(
+            self,
+            storage_faults=(),
+            stall_rounds=(),
+            preempt_round=None,
+            corrupt_newest=False,
+            dead_worker=None,
+        )
+
+
+def storage_fault_hook(plan: FaultPlan, counters: Dict[str, int]):
+    """A ``data/object_store.set_fault_hook`` injector: raises
+    ``ConnectionResetError`` for the first N fetch attempts per planned
+    round-slot, keyed round-robin by call order.  Used by tests to prove
+    ``object_store._get`` heals under the SAME fault source the chaos
+    run uses."""
+    remaining = {r: n for r, n in plan.storage_faults}
+    order = sorted(remaining)
+    slot = {"i": 0}
+
+    def hook(url: str) -> None:
+        if slot["i"] >= len(order):
+            return None
+        r = order[slot["i"]]
+        if remaining[r] > 0:
+            remaining[r] -= 1
+            counters["storage_injected"] = (
+                counters.get("storage_injected", 0) + 1
+            )
+            raise ConnectionResetError(
+                f"chaos: injected storage fault (slot {r}) for {url}"
+            )
+        # slot spent: THIS call passes (the fetch the faults were
+        # aimed at succeeds) and the next slot arms for a LATER fetch —
+        # slots never bleed into one call's retry loop
+        slot["i"] += 1
+        return None
+
+    return hook
+
+
+def corrupt_file(path: str, seed: int = 0) -> None:
+    """Flip a run of bytes in the middle of ``path`` (size unchanged —
+    only a checksum can catch it; truncation is the easy case)."""
+    size = os.path.getsize(path)
+    rng = random.Random(seed)
+    with open(path, "r+b") as f:
+        off = max(0, size // 2 - 8)
+        f.seek(off)
+        orig = f.read(16)
+        f.seek(off)
+        f.write(bytes((b ^ 0xA5) for b in orig) or bytes([rng.randrange(256)]))
+
+
+# ----------------------------------------------------------------------
+# the chaos training run
+
+
+class _Feed:
+    """Deterministic per-round window builder behind a Prefetcher, with
+    storage faults (transient errors healed by retry) and stalls
+    (producer wedges past the watchdog) injected per plan."""
+
+    def __init__(self, plan: FaultPlan, xs, ys, counters, events,
+                 fault_state=None):
+        self.plan = plan
+        self.xs, self.ys = xs, ys
+        self.counters = counters
+        self.events = events
+        # fault state is SHARED across prefetcher/feed rebuilds (resume
+        # replays rounds by absolute index; a per-round fault fires once)
+        fault_state = fault_state if fault_state is not None else {}
+        fault_state.setdefault("faults", {r: n for r, n in plan.storage_faults})
+        fault_state.setdefault("stalls", set(plan.stall_rounds))
+        self._faults = fault_state["faults"]
+        self._stalls = fault_state["stalls"]
+        self._pf = None
+        self._policy = _retry.RetryPolicy(
+            max_attempts=6, base_s=0.005, cap_s=0.02, budget_s=2.0
+        )
+
+    def _build(self, r: int):
+        p, W, tau, B = self.plan, self.plan.workers, self.plan.tau, self.plan.batch
+        n = len(self.xs)
+        data = np.empty((W, tau) + self.xs[0].shape, np.float32)
+        label = np.empty((W, tau, B), np.float32)
+        for w in range(W):
+            for t in range(tau):
+                i = (r * W * tau + w * tau + t) % n
+                data[w, t] = self.xs[i]
+                label[w, t] = self.ys[i]
+        return {"data": data, "label": label}
+
+    def _produce_round(self, r: int):
+        def attempt():
+            if self._faults.get(r, 0) > 0:
+                self._faults[r] -= 1
+                self.counters["storage_injected"] += 1
+                raise ConnectionResetError(
+                    f"chaos: storage fault in round {r} fetch"
+                )
+            if r in self._stalls:
+                self._stalls.discard(r)
+                self.counters["stalls_injected"] += 1
+                self.events.append(f"round {r}: producer stalled {self.plan.stall_s}s")
+                time.sleep(self.plan.stall_s)
+            return self._build(r)
+
+        injected_before = self.counters["storage_injected"]
+        out = _retry.retry_call(
+            attempt,
+            policy=self._policy,
+            rng=random.Random(self.plan.seed * 1000 + r),
+        )
+        healed = self.counters["storage_injected"] - injected_before
+        if healed:
+            self.counters["storage_survived"] += healed
+            self.events.append(
+                f"round {r}: retry layer healed {healed} storage fault(s)"
+            )
+        return out
+
+    def _spawn(self, start_r: int):
+        from sparknet_tpu.data.prefetch import Prefetcher
+
+        # the round cursor is LOCAL to this prefetcher generation: a
+        # producer thread that outlives stop() (a stall longer than the
+        # reap timeout) keeps bumping ITS cursor, never the rebuilt
+        # generation's — no round can be silently skipped
+        cur = [start_r]
+
+        def produce():
+            out = self._produce_round(cur[0])
+            cur[0] += 1
+            return out
+
+        self._pf = Prefetcher(
+            produce,
+            depth=2,
+            device_put=False,
+            stall_timeout_s=self.plan.stall_timeout_s,
+        )
+
+    def next_round(self, r: int):
+        """The (workers, tau, ...) host batches for absolute round ``r``,
+        surviving producer stalls by rebuilding the prefetcher.  A stall
+        counts as survived once the round is DELIVERED — whether the
+        watchdog fired and the prefetcher was rebuilt, or the stall was
+        absorbed by the prefetch depth (the producer was far enough
+        ahead that training never noticed)."""
+        from sparknet_tpu.data.prefetch import PrefetchStall
+
+        if self._pf is None:
+            self._spawn(r)
+        while True:
+            try:
+                out = next(self._pf)
+                break
+            except PrefetchStall:
+                exited = self._pf.stop()
+                self.counters["watchdog_fires"] = (
+                    self.counters.get("watchdog_fires", 0) + 1
+                )
+                self.events.append(
+                    "round %d: watchdog fired; prefetcher stopped "
+                    "(thread exited: %s); rebuilding" % (r, exited)
+                )
+                self._spawn(r)
+        if r in self.plan.stall_rounds and r not in self._stalls:
+            # this round's planned stall has been consumed and the round
+            # still arrived
+            if (
+                self.counters["stalls_survived"]
+                < self.counters["stalls_injected"]
+            ):
+                self.counters["stalls_survived"] += 1
+        return out
+
+    def close(self):
+        if self._pf is not None:
+            self._pf.stop()
+            self._pf = None
+
+
+def run_chaos(
+    plan: Optional[FaultPlan] = None,
+    workdir: Optional[str] = None,
+    verbose: bool = False,
+) -> Dict:
+    """Run the full chaos scenario; returns the CHAOS artifact dict.
+
+    Builds one cifar10_quick ParameterAveragingTrainer on the virtual
+    mesh, runs the NO-FAULT baseline first (same data, same seed), then
+    the faulted run: train -> faults -> SIGHUP preemption -> snapshot ->
+    simulated death -> corrupt newest snapshot -> verified resume with
+    fallback -> survivor-masked rounds -> final loss vs baseline band."""
+    import jax
+
+    from sparknet_tpu import config as cfg, models
+    from sparknet_tpu.data import CifarLoader
+    from sparknet_tpu.io import checkpoint
+    from sparknet_tpu.parallel import (
+        ParameterAveragingTrainer,
+        first_worker,
+        make_mesh,
+        shard_leading,
+    )
+    from sparknet_tpu.solver import Solver
+    from sparknet_tpu.utils.signals import SignalHandler, SolverAction
+
+    plan = plan or FaultPlan.default()
+    if jax.device_count() < plan.workers:
+        raise RuntimeError(
+            f"chaos needs >= {plan.workers} devices (virtual CPU mesh: "
+            f"utils.devices.force_virtual_cpu_devices); have "
+            f"{jax.device_count()}"
+        )
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos_")
+    os.makedirs(workdir, exist_ok=True)
+
+    events: List[str] = []
+
+    def note(msg: str) -> None:
+        events.append(msg)
+        if verbose:
+            print(f"chaos: {msg}")
+
+    # deterministic learnable data (synthetic CIFAR-format)
+    data_dir = os.path.join(workdir, "data")
+    if not os.path.isdir(data_dir):
+        CifarLoader.write_synthetic(
+            data_dir, num_train=512, num_test=64, seed=plan.seed
+        )
+    xs, ys = CifarLoader(data_dir).minibatches(plan.batch, train=True)
+
+    netp = cfg.replace_data_layers(
+        models.load_model("cifar10_quick"),
+        [(plan.batch, 3, 32, 32), (plan.batch,)],
+        [(plan.batch, 3, 32, 32), (plan.batch,)],
+    )
+    solver = Solver(
+        models.load_model_solver("cifar10_quick"), net_param=netp
+    )
+    mesh = make_mesh(
+        {"dp": plan.workers}, devices=jax.devices()[: plan.workers]
+    )
+    trainer = ParameterAveragingTrainer(solver, mesh)
+
+    def broadcast(st):
+        n = trainer.num_workers
+        stacked = jax.tree_util.tree_map(
+            lambda x: np.broadcast_to(
+                np.asarray(x), (n,) + np.asarray(x).shape
+            ).copy(),
+            jax.device_get(st),
+        )
+        return shard_leading(stacked, mesh)
+
+    def final_round_loss(losses) -> float:
+        return float(np.mean(np.asarray(jax.device_get(losses))))
+
+    # ---------------- baseline: the same run shape, zero faults
+    base_plan = plan.no_fault_view()
+    base_counters = {
+        "storage_injected": 0, "storage_survived": 0,
+        "stalls_injected": 0, "stalls_survived": 0,
+    }
+    feed = _Feed(base_plan, xs, ys, base_counters, events)
+    state = trainer.init_state(seed=plan.seed)
+    losses = None
+    for r in range(plan.rounds):
+        batches = shard_leading(feed.next_round(r), mesh)
+        state, losses = trainer.round(state, batches)
+    feed.close()
+    baseline_loss = final_round_loss(losses)
+    note(f"baseline (no faults): final-round loss {baseline_loss:.4f}")
+
+    # ---------------- the faulted run
+    counters = {
+        "storage_injected": 0, "storage_survived": 0,
+        "stalls_injected": 0, "stalls_survived": 0,
+    }
+    fault_state: Dict = {}
+    feed = _Feed(plan, xs, ys, counters, events, fault_state)
+    prefix = os.path.join(workdir, "chaos_ckpt")
+    state = trainer.init_state(seed=plan.seed)
+    losses = None
+    preempted_at: Optional[int] = None
+    snapshots = 0
+
+    def take_snapshot(r: int) -> Tuple[str, str]:
+        nonlocal snapshots
+        st = first_worker(jax.device_get(state))
+        paths = checkpoint.snapshot(solver, st, prefix, fmt="BINARYPROTO")
+        snapshots += 1
+        note(f"round {r}: snapshot -> {os.path.basename(paths[1])}")
+        return paths
+
+    def live_mask_for(r: int):
+        if plan.dead_worker is None or r < plan.dead_from_round:
+            return None
+        mask = np.ones((plan.workers,), np.float32)
+        mask[plan.dead_worker] = 0.0
+        return mask
+
+    def run_round(fd: _Feed, r: int) -> None:
+        """One training round of the faulted run (shared by the
+        pre-preemption loop and the post-resume replay — fault
+        accounting must stay identical in both)."""
+        nonlocal state, losses
+        batches = shard_leading(fd.next_round(r), mesh)
+        mask = live_mask_for(r)
+        if mask is not None and r == plan.dead_from_round:
+            counters["dead_worker_injected"] = 1
+            note(
+                f"round {r}: dp worker {plan.dead_worker} died; "
+                "averaging over survivors"
+            )
+        state, losses = trainer.round(state, batches, live_mask=mask)
+
+    t_preempt = None
+    with SignalHandler(
+        sigint_effect=SolverAction.NONE,
+        sighup_effect=SolverAction.SNAPSHOT,
+    ) as handler:
+        for r in range(plan.rounds):
+            run_round(feed, r)
+            snapped = (r + 1) % plan.snapshot_every == 0
+            if snapped:
+                take_snapshot(r)
+            if plan.preempt_round is not None and r == plan.preempt_round:
+                # a REAL signal, not a flag: the orchestrator's
+                # preemption notice arrives as SIGHUP
+                os.kill(os.getpid(), _signal.SIGHUP)
+                # the driver's poll sees SNAPSHOT (reference SIGHUP
+                # semantics), saves — unless the periodic snapshot
+                # already covered this exact iteration — and "dies"
+                if (
+                    handler.get_action() == SolverAction.SNAPSHOT
+                    and not snapped
+                ):
+                    take_snapshot(r)
+                counters["preempt_injected"] = 1
+                t_preempt = time.perf_counter()
+                preempted_at = r
+                note(f"round {r}: SIGHUP preemption — simulated process death")
+                break
+    feed.close()
+
+    resumed_from_iter = None
+    quarantined: List[str] = []
+    recovery_latency_s = None
+    if preempted_at is not None:
+        # simulated restart: live state is GONE; only files survive
+        state = None
+        if plan.corrupt_newest:
+            newest = checkpoint.find_snapshots(prefix)[-1]
+            corrupt_file(newest, seed=plan.seed)
+            counters["corruption_injected"] = 1
+            note(f"corrupted newest snapshot {os.path.basename(newest)}")
+        st, used = checkpoint.restore_newest_valid(solver, prefix)
+        resumed_from_iter = int(np.asarray(st.iter))
+        quarantined = [
+            os.path.basename(p)
+            for p in sorted(os.listdir(workdir))
+            if p.endswith(".corrupt")
+        ]
+        if plan.corrupt_newest:
+            if quarantined and used != newest:
+                counters["corruption_survived"] = 1
+            note(
+                f"resume fell back to {os.path.basename(used)} "
+                f"(quarantined: {quarantined})"
+            )
+        state = broadcast(st)
+        recovery_latency_s = time.perf_counter() - t_preempt
+        counters["preempt_survived"] = 1
+        start_round = resumed_from_iter // plan.tau
+        note(
+            "resumed at round %d (iter %d) in %.2fs; replaying %d round(s)"
+            % (
+                start_round,
+                resumed_from_iter,
+                recovery_latency_s,
+                preempted_at + 1 - start_round,
+            )
+        )
+        feed = _Feed(plan, xs, ys, counters, events, fault_state)
+        for r in range(start_round, plan.rounds):
+            run_round(feed, r)
+        feed.close()
+
+    final_loss = final_round_loss(losses)
+    if counters.get("dead_worker_injected") and np.isfinite(final_loss):
+        counters["dead_worker_survived"] = 1
+
+    loss_band = max(0.25, 0.25 * abs(baseline_loss))
+    loss_band_ok = bool(abs(final_loss - baseline_loss) <= loss_band)
+    note(
+        f"final-round loss {final_loss:.4f} vs baseline "
+        f"{baseline_loss:.4f} (band +/-{loss_band:.3f}: "
+        f"{'OK' if loss_band_ok else 'OUT OF BAND'})"
+    )
+
+    fault_kinds = {
+        "storage": ("storage_injected", "storage_survived"),
+        "stall": ("stalls_injected", "stalls_survived"),
+        "preemption": ("preempt_injected", "preempt_survived"),
+        "snapshot_corruption": (
+            "corruption_injected", "corruption_survived",
+        ),
+        "dead_worker": ("dead_worker_injected", "dead_worker_survived"),
+    }
+    faults = {
+        kind: {
+            "injected": int(counters.get(ik, 0)),
+            "survived": int(counters.get(sk, 0)),
+        }
+        for kind, (ik, sk) in fault_kinds.items()
+    }
+    injected = sum(v["injected"] for v in faults.values())
+    survived = sum(v["survived"] for v in faults.values())
+    return {
+        "seed": plan.seed,
+        "workers": plan.workers,
+        "rounds": plan.rounds,
+        "tau": plan.tau,
+        "batch": plan.batch,
+        "faults_injected": injected,
+        "faults_survived": survived,
+        "faults": faults,
+        "watchdog_fires": int(counters.get("watchdog_fires", 0)),
+        "recovery_latency_s": (
+            round(recovery_latency_s, 3)
+            if recovery_latency_s is not None
+            else None
+        ),
+        "resumed_from_iter": resumed_from_iter,
+        "quarantined": quarantined,
+        "final_loss": round(final_loss, 4),
+        "baseline_final_loss": round(baseline_loss, 4),
+        "loss_band": round(loss_band, 4),
+        "loss_band_ok": loss_band_ok,
+        "final_iter": plan.rounds * plan.tau,
+        "events": events,
+    }

@@ -66,7 +66,13 @@ class _Handler(BaseHTTPRequestHandler):
         ctx = self.server_ctx
         if self.path == "/healthz":
             if ctx.draining:
-                self._send_json(503, {"status": "draining"})
+                # Retry-After on every 503/429: retrying clients (e.g.
+                # utils/retry.py honors the header) back off instead of
+                # hammering a replica that is leaving rotation
+                self._send_json(
+                    503, {"status": "draining"},
+                    extra_headers=[("Retry-After", "1")],
+                )
             else:
                 self._send_json(200, {"status": "ok"})
         elif self.path == "/metrics":
@@ -92,7 +98,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(404, {"error": f"no route {self.path}"})
             return
         if ctx.draining:
-            self._send_json(503, {"status": "draining"})
+            self._send_json(
+                503, {"status": "draining"},
+                extra_headers=[("Retry-After", "1")],
+            )
             return
         try:
             body = json.loads(raw or b"{}")
@@ -133,7 +142,10 @@ class _Handler(BaseHTTPRequestHandler):
             # XlaRuntimeError) must NOT masquerade as one — the LB
             # would keep routing while operators chase a phantom drain
             if ctx.draining:
-                self._send_json(503, {"status": "draining"})
+                self._send_json(
+                    503, {"status": "draining"},
+                    extra_headers=[("Retry-After", "1")],
+                )
             else:
                 self._send_json(500, {"error": f"inference failed: {e}"})
             return

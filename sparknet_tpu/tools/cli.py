@@ -62,6 +62,15 @@ def _declared_feed_shapes(netp, phase):
 
 
 def cmd_train(args) -> int:
+    # pure argument conflicts fail BEFORE any model/device setup
+    if args.resume and (args.snapshot or args.weights):
+        print(
+            "train: --resume scans the solver's snapshot_prefix and "
+            "conflicts with --snapshot/--weights — pass one or the other",
+            file=sys.stderr,
+        )
+        return 1
+
     import jax
 
     from sparknet_tpu import config
@@ -109,7 +118,21 @@ def cmd_train(args) -> int:
         print(f"allreduce data-parallel over {n} devices")
     else:
         solver = Solver(solver_param)
-    if args.snapshot:
+    # one prefix rule for BOTH writing snapshots and --resume's scan
+    prefix = solver_param.snapshot_prefix or "snapshot"
+    if args.resume:
+        # fault-tolerant resume: newest CRC-valid snapshot under the
+        # solver's snapshot_prefix; corrupt ones are quarantined and the
+        # scan falls back (io/checkpoint.restore_newest_valid)
+        try:
+            state, used = checkpoint.restore_newest_valid(solver, prefix)
+        except (FileNotFoundError, checkpoint.SnapshotCorrupt) as e:
+            print(f"train: --resume: {e}", file=sys.stderr)
+            return 1
+        if trainer is not None:
+            state = trainer.shard_state(state)
+        print(f"resumed from {used} at iter {int(state.iter)}")
+    elif args.snapshot:
         state = checkpoint.restore(solver, args.snapshot)
         if trainer is not None:
             state = trainer.shard_state(state)
@@ -131,10 +154,6 @@ def cmd_train(args) -> int:
         "snapshot": SolverAction.SNAPSHOT,
         "none": SolverAction.NONE,
     }
-    handler = SignalHandler(
-        sigint_effect=effects[args.sigint_effect],
-        sighup_effect=effects[args.sighup_effect],
-    )
     log = TrainingLog(tag="train")
 
     sampler = None
@@ -149,7 +168,6 @@ def cmd_train(args) -> int:
 
     max_iter = args.max_iter or solver_param.max_iter or 1000
     snap_every = solver_param.snapshot
-    prefix = solver_param.snapshot_prefix or "snapshot"
     # --async_snapshot: serialization + file writes happen on a worker
     # thread so the train loop keeps stepping (Orbax-style async
     # checkpointing; the snapshot itself still publishes atomically)
@@ -158,45 +176,50 @@ def cmd_train(args) -> int:
     # per-round device_get of state.iter would sync the async dispatch
     # queue (and degrade the put lane on the axon relay — PERF.md)
     it = int(jax.device_get(state.iter))
-    while it < max_iter:
-        batches = (
-            sampler.next_window()
-            if sampler
-            else _synthetic_batches(solver.net, args.tau)
-        )
-        if trainer is not None:
-            state, _ = trainer.step(state, batches)
-        else:
-            state, _ = solver.step(state, batches)
-        it += args.tau
-        # throttled logging (SolverParameter.display semantics,
-        # solver.cpp:237): reading smoothed_loss is the device sync
-        # point, so it runs once per display interval, not per window
-        disp = solver_param.display or args.tau
-        if it % disp < args.tau:
-            log.log(f"iter {it} smoothed_loss {solver.smoothed_loss:.4f}")
-        action = handler.get_action()
-        if action == SolverAction.SNAPSHOT or (
-            snap_every and it % snap_every < args.tau and it >= snap_every
-        ):
-            if ckpt is not None:
-                ckpt.save(solver, state, prefix)
-                log.log(f"async snapshot started at iter {it}")
+    # the context manager guarantees the previous handler chain comes
+    # back even when a step raises (no leaked handlers on exceptions)
+    with SignalHandler(
+        sigint_effect=effects[args.sigint_effect],
+        sighup_effect=effects[args.sighup_effect],
+    ) as handler:
+        while it < max_iter:
+            batches = (
+                sampler.next_window()
+                if sampler
+                else _synthetic_batches(solver.net, args.tau)
+            )
+            if trainer is not None:
+                state, _ = trainer.step(state, batches)
             else:
-                paths = checkpoint.snapshot(solver, state, prefix)
-                log.log(f"snapshotted to {paths[0]}")
-        if action == SolverAction.STOP:
-            log.log("stop requested; snapshotting and exiting")
-            if ckpt is not None:
-                ckpt.save(solver, state, prefix)
-            else:
-                checkpoint.snapshot(solver, state, prefix)
-            break
-    if ckpt is not None:
-        paths = ckpt.wait()
-        if paths:
-            log.log(f"final async snapshot: {paths[0]}")
-    handler.restore()
+                state, _ = solver.step(state, batches)
+            it += args.tau
+            # throttled logging (SolverParameter.display semantics,
+            # solver.cpp:237): reading smoothed_loss is the device sync
+            # point, so it runs once per display interval, not per window
+            disp = solver_param.display or args.tau
+            if it % disp < args.tau:
+                log.log(f"iter {it} smoothed_loss {solver.smoothed_loss:.4f}")
+            action = handler.get_action()
+            if action == SolverAction.SNAPSHOT or (
+                snap_every and it % snap_every < args.tau and it >= snap_every
+            ):
+                if ckpt is not None:
+                    ckpt.save(solver, state, prefix)
+                    log.log(f"async snapshot started at iter {it}")
+                else:
+                    paths = checkpoint.snapshot(solver, state, prefix)
+                    log.log(f"snapshotted to {paths[0]}")
+            if action == SolverAction.STOP:
+                log.log("stop requested; snapshotting and exiting")
+                if ckpt is not None:
+                    ckpt.save(solver, state, prefix)
+                else:
+                    checkpoint.snapshot(solver, state, prefix)
+                break
+        if ckpt is not None:
+            paths = ckpt.wait()
+            if paths:
+                log.log(f"final async snapshot: {paths[0]}")
     return 0
 
 
@@ -715,6 +738,10 @@ def main(argv=None) -> int:
     p = sub.add_parser("train")
     p.add_argument("--solver", required=True)
     p.add_argument("--snapshot", default=None)
+    p.add_argument("--resume", action="store_true",
+                   help="continue from the newest CRC-valid snapshot "
+                   "under the solver's snapshot_prefix (corrupt ones "
+                   "are quarantined and skipped)")
     p.add_argument("--weights", default=None)
     p.add_argument("--data", default=None, help="CIFAR binary dir")
     p.add_argument("--tau", type=int, default=10)

@@ -1,5 +1,11 @@
-"""Aux subsystems: timers, signal handling, profiling, experiment logs."""
+"""Aux subsystems: timers, signal handling, retry/backoff, profiling,
+experiment logs."""
 
+from sparknet_tpu.utils.retry import (  # noqa: F401
+    RetryBudgetExceeded,
+    RetryPolicy,
+    retry_call,
+)
 from sparknet_tpu.utils.signals import SignalHandler, SolverAction  # noqa: F401
 from sparknet_tpu.utils.timers import CPUTimer, Timer  # noqa: F401
 from sparknet_tpu.utils.trainlog import TrainingLog  # noqa: F401

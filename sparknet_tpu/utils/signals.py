@@ -59,5 +59,18 @@ class SignalHandler:
         return SolverAction.NONE
 
     def restore(self):
-        for sig, prev in self._prev.items():
-            signal.signal(sig, prev)
+        """Reinstall the previous handlers.  Idempotent — a second call
+        (e.g. ``__exit__`` after an explicit ``restore()``) is a no-op,
+        so it can never clobber handlers installed after this one."""
+        prev, self._prev = self._prev, {}
+        for sig, handler in prev.items():
+            signal.signal(sig, handler)
+
+    # context-manager form: driver loops can't leak handlers on an
+    # exception path (``with SignalHandler(...) as h: ...`` restores the
+    # previous handler chain on ANY exit; nested handlers unwind LIFO)
+    def __enter__(self) -> "SignalHandler":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.restore()

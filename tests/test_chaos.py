@@ -1,0 +1,153 @@
+"""Chaos harness (``runtime/chaos.py``): the tier-1 smoke runs the FULL
+default FaultPlan on the virtual mesh — storage faults healed by retry,
+a producer stall through the prefetch watchdog, a real SIGHUP
+preemption with simulated process death, newest-snapshot corruption
+quarantined + fallback restore, and a dead dp worker masked out of the
+average — and requires every injected fault survived plus a final loss
+inside the no-fault baseline's band (the acceptance bar for
+``CHAOS_r07.json``)."""
+
+import dataclasses
+import os
+
+import pytest
+
+from sparknet_tpu.runtime import chaos
+
+
+def test_default_plan_covers_every_fault_class():
+    plan = chaos.FaultPlan.default()
+    assert plan.storage_faults and plan.stall_rounds
+    assert plan.preempt_round is not None and plan.corrupt_newest
+    assert plan.dead_worker is not None
+    # the preemption must happen after at least one periodic snapshot,
+    # or there is nothing valid to fall back to after the corruption
+    assert plan.preempt_round + 1 > plan.snapshot_every
+
+
+def test_no_fault_view_strips_all_faults():
+    base = chaos.FaultPlan.default().no_fault_view()
+    assert base.storage_faults == () and base.stall_rounds == ()
+    assert base.preempt_round is None and not base.corrupt_newest
+    assert base.dead_worker is None
+    # run geometry unchanged: the baseline is comparable
+    plan = chaos.FaultPlan.default()
+    for f in ("seed", "workers", "rounds", "tau", "batch"):
+        assert getattr(base, f) == getattr(plan, f)
+
+
+def test_corrupt_file_flips_bytes_in_place(tmp_path):
+    p = str(tmp_path / "blob.bin")
+    payload = bytes(range(256)) * 64
+    with open(p, "wb") as f:
+        f.write(payload)
+    chaos.corrupt_file(p, seed=3)
+    with open(p, "rb") as f:
+        after = f.read()
+    assert len(after) == len(payload)  # size unchanged: CRC territory
+    assert after != payload
+
+
+def test_storage_fault_hook_injects_then_heals():
+    plan = dataclasses.replace(
+        chaos.FaultPlan.default(), storage_faults=((0, 2),)
+    )
+    counters = {}
+    hook = chaos.storage_fault_hook(plan, counters)
+    with pytest.raises(ConnectionResetError):
+        hook("http://x/a")
+    with pytest.raises(ConnectionResetError):
+        hook("http://x/a")
+    assert hook("http://x/a") is None  # budget spent: attempts pass
+    assert counters["storage_injected"] == 2
+
+
+def test_storage_fault_hook_slots_never_bleed_into_one_fetch():
+    """Each slot's faults end with a SUCCESSFUL call before the next
+    slot arms — a fetch planned to survive N faults is never handed the
+    next slot's faults in the same retry loop."""
+    plan = dataclasses.replace(
+        chaos.FaultPlan.default(), storage_faults=((0, 2), (4, 1))
+    )
+    counters = {}
+    hook = chaos.storage_fault_hook(plan, counters)
+    # fetch 1: two faults, then success (slot 0 retires on the success)
+    for _ in range(2):
+        with pytest.raises(ConnectionResetError):
+            hook("http://x/a")
+    assert hook("http://x/a") is None
+    # fetch 2: exactly slot 1's single fault, then success
+    with pytest.raises(ConnectionResetError):
+        hook("http://x/b")
+    assert hook("http://x/b") is None
+    # schedule exhausted: every later call passes
+    assert hook("http://x/c") is None
+    assert counters["storage_injected"] == 3
+
+
+def test_feed_delivers_rounds_in_order_across_watchdog_rebuild():
+    """The feed's round cursor is per-prefetcher-generation: after a
+    stall fires the watchdog and the prefetcher is rebuilt, every round
+    still arrives exactly once, in order, with the right contents (a
+    stale producer thread can never skip a round)."""
+    import numpy as np
+
+    plan = dataclasses.replace(
+        chaos.FaultPlan.default(),
+        workers=2, tau=1, batch=4, rounds=4,
+        storage_faults=(), stall_rounds=(1,),
+        stall_s=0.8, stall_timeout_s=0.2,
+        preempt_round=None, corrupt_newest=False, dead_worker=None,
+    )
+    # distinct constant per minibatch index -> contents identify indices
+    xs = [np.full((4, 3, 4, 4), i, np.float32) for i in range(8)]
+    ys = [np.full((4,), float(i % 4), np.float32) for i in range(8)]
+    counters = {
+        "storage_injected": 0, "storage_survived": 0,
+        "stalls_injected": 0, "stalls_survived": 0,
+    }
+    feed = chaos._Feed(plan, xs, ys, counters, [])
+    try:
+        for r in range(plan.rounds):
+            b = feed.next_round(r)
+            for w in range(plan.workers):
+                for t in range(plan.tau):
+                    i = (r * plan.workers * plan.tau + w * plan.tau + t) % 8
+                    assert float(b["data"][w, t, 0, 0, 0, 0]) == float(i), (
+                        r, w, t,
+                    )
+    finally:
+        feed.close()
+    assert counters["stalls_injected"] == 1
+    assert counters["stalls_survived"] == 1
+
+
+@pytest.mark.chaos
+def test_chaos_smoke_default_plan(tmp_path):
+    """The tier-1 chaos smoke (ISSUE 2 acceptance): default seeded
+    FaultPlan, virtual mesh, every fault survived, loss in band."""
+    rep = chaos.run_chaos(workdir=str(tmp_path))
+
+    assert rep["faults_injected"] > 0
+    assert rep["faults_survived"] == rep["faults_injected"]
+    # every fault CLASS fired and survived
+    for kind, v in rep["faults"].items():
+        assert v["injected"] >= 1, kind
+        assert v["survived"] == v["injected"], (kind, v)
+
+    # the run resumed from a VERIFIED snapshot (not the corrupted one)
+    assert rep["resumed_from_iter"] is not None
+    assert rep["resumed_from_iter"] < rep["final_iter"]
+    assert rep["quarantined"], "corrupt snapshot must be quarantined"
+    assert any(".corrupt" in q for q in rep["quarantined"])
+    assert rep["recovery_latency_s"] is not None
+    assert 0 < rep["recovery_latency_s"] < 60
+
+    # final loss within the no-fault run's band
+    assert rep["loss_band_ok"], (
+        rep["final_loss"], rep["baseline_final_loss"], rep["loss_band"]
+    )
+
+    # quarantined files really are on disk, out of the resume scan
+    corrupt = [f for f in os.listdir(str(tmp_path)) if f.endswith(".corrupt")]
+    assert corrupt

@@ -205,6 +205,54 @@ def test_cli_train_devices_allreduce(tmp_path, toy_model, cifar_dir, capsys):
     assert "resumed from" in capsys.readouterr().out
 
 
+def test_cli_train_resume_conflicts_with_snapshot(tmp_path, toy_model, capsys):
+    """--resume scans the solver's snapshot_prefix; naming an explicit
+    --snapshot (or --weights) alongside it is a conflict, not a silent
+    preference."""
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(
+        f'net: "{toy_model}"\nbase_lr: 0.01\nlr_policy: "fixed"\nmax_iter: 5\n'
+        f'snapshot_prefix: "{tmp_path}/ck"\n'
+    )
+    rc = cli.main([
+        "train", f"--solver={solver}", "--resume",
+        f"--snapshot={tmp_path}/ck_iter_5.solverstate.npz",
+    ])
+    assert rc == 1
+    assert "conflicts with --snapshot/--weights" in capsys.readouterr().err
+
+
+def test_cli_train_resume_falls_back_past_corrupt_snapshot(
+    tmp_path, toy_model, capsys
+):
+    """cli train --resume: corrupt newest snapshot is quarantined and
+    the run resumes from the older valid one."""
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(
+        f'net: "{toy_model}"\n'
+        'base_lr: 0.05\nlr_policy: "fixed"\nmomentum: 0.9\n'
+        "snapshot: 2\n"
+        f'snapshot_prefix: "{tmp_path}/ck"\n'
+    )
+    rc = cli.main(["train", f"--solver={solver}", "--tau=2", "--max_iter=4"])
+    assert rc == 0
+    capsys.readouterr()
+    from sparknet_tpu.io import checkpoint
+    from sparknet_tpu.runtime import chaos
+
+    snaps = checkpoint.find_snapshots(str(tmp_path / "ck"))
+    assert len(snaps) == 2
+    chaos.corrupt_file(snaps[-1])
+    rc = cli.main(
+        ["train", f"--solver={solver}", "--tau=2", "--max_iter=6",
+         "--resume"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert f"resumed from {snaps[0]}" in out
+    assert os.path.exists(snaps[-1] + ".corrupt")
+
+
 def test_cli_train_devices_exceeding_available(tmp_path, toy_model, capsys):
     solver = tmp_path / "solver.prototxt"
     solver.write_text(

@@ -62,11 +62,20 @@ def test_run_train_snapshot_resume_eval(db_dir, tmp_path, capsys):
     snaps = glob.glob(prefix + "_iter_*.solverstate*")
     assert len(snaps) == 2, snaps
 
-    # phase B: resume from the newest snapshot, train 1 more round + eval
+    # phase B: corrupt the NEWEST snapshot (preemption-mid-write story);
+    # --resume must quarantine it and fall back to the older valid one
+    from sparknet_tpu.io import checkpoint
+    from sparknet_tpu.runtime import chaos
+
+    newest = checkpoint.find_snapshots(prefix)[-1]
+    chaos.corrupt_file(newest)
     rc = imagenet_run_db_app.main(common + ["--rounds", "1", "--resume"])
     assert rc == 0
     out = capsys.readouterr().out
     assert "resumed from" in out
+    older = checkpoint.find_snapshots(prefix)[0]
+    assert f"resumed from {older}" in out  # fell back past the corrupt one
+    assert os.path.exists(newest + ".corrupt")  # quarantined, not fatal
     assert "final accuracy" in out
     acc = float(out.rsplit("final accuracy", 1)[1].strip().rstrip("%"))
     assert 0.0 <= acc <= 100.0

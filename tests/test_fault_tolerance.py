@@ -1,0 +1,452 @@
+"""Fault-tolerance layer tests: Prefetcher shutdown/watchdog, the
+SignalHandler context manager, checkpoint manifest integrity +
+newest-valid fallback with quarantine, _atomic crash semantics, and
+survivor-aware parameter averaging.
+
+These are the unit-level proofs behind the chaos harness
+(``runtime/chaos.py`` / ``tests/test_chaos.py`` run them end to end)."""
+
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+import jax
+
+from sparknet_tpu import config
+from sparknet_tpu.data.prefetch import Prefetcher, PrefetchStall
+from sparknet_tpu.io import checkpoint
+from sparknet_tpu.parallel import (
+    ParameterAveragingTrainer,
+    make_mesh,
+    shard_leading,
+)
+from sparknet_tpu.solver import Solver
+from sparknet_tpu.utils.signals import SignalHandler, SolverAction
+
+# ----------------------------------------------------------------------
+# Prefetcher: robust stop() + stall watchdog
+
+NET = """
+name: "ft_net"
+layer { name: "data" type: "HostData" top: "x" top: "label"
+  java_data_param { shape { dim: 8 dim: 6 } shape { dim: 8 } } }
+layer { name: "ip1" type: "InnerProduct" bottom: "x" top: "h"
+  inner_product_param { num_output: 16 weight_filler { type: "xavier" } } }
+layer { name: "relu" type: "ReLU" bottom: "h" top: "h" }
+layer { name: "ip2" type: "InnerProduct" bottom: "h" top: "logits"
+  inner_product_param { num_output: 4 weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "logits" bottom: "label" top: "loss" }
+"""
+
+
+def _solver(momentum=0.9):
+    sp = config.parse_solver_prototxt(
+        f'base_lr: 0.05 lr_policy: "fixed" momentum: {momentum}'
+    )
+    return Solver(sp, net_param=config.parse_net_prototxt(NET))
+
+
+def _batches(tau, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "x": rng.randn(tau, 8, 6).astype(np.float32),
+        "label": rng.randint(0, 4, (tau, 8)).astype(np.float32),
+    }
+
+
+def test_prefetcher_stop_reaps_slow_producer():
+    """Regression for the single-drain stop(): a producer that is slow
+    in produce() (not just blocked in put) must still be reaped — the
+    old code drained once, the producer re-filled the queue, and
+    join(5) could time out while put blocked forever."""
+    def produce():
+        time.sleep(0.05)  # slow enough to be mid-produce at stop() time
+        return {"x": np.zeros(2, np.float32)}
+
+    pf = Prefetcher(produce, depth=1, device_put=False)
+    next(pf)  # producer is live and the queue refills behind this get
+    assert pf.stop(timeout=5.0) is True
+    assert not pf._thread.is_alive()
+
+
+def test_prefetcher_stop_is_idempotent():
+    pf = Prefetcher(lambda: {"x": np.zeros(1, np.float32)},
+                    depth=1, device_put=False)
+    next(pf)
+    assert pf.stop() is True
+    assert pf.stop() is True  # second call: recorded outcome, no work
+
+
+def test_prefetcher_stop_reports_wedged_thread():
+    """A producer wedged past the stop timeout is REPORTED (False), not
+    silently leaked — and a later stop() sees it exit."""
+    release = threading.Event()
+
+    def produce():
+        release.wait(10.0)
+        return None
+
+    pf = Prefetcher(produce, depth=1, device_put=False)
+    assert pf.stop(timeout=0.3) is False  # thread still inside produce()
+    release.set()
+    deadline = time.monotonic() + 5.0
+    while pf._thread.is_alive() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert pf.stop() is True  # idempotent path re-checks liveness
+
+
+def test_prefetcher_stall_watchdog_raises():
+    """The consumer never hangs forever on a wedged producer: past
+    stall_timeout_s, __next__ raises PrefetchStall naming the thread
+    state, and the prefetcher can then be torn down and rebuilt."""
+    hang = threading.Event()
+
+    def produce():
+        if hang.is_set():
+            time.sleep(5.0)
+        hang.set()
+        return {"x": np.zeros(1, np.float32)}
+
+    pf = Prefetcher(produce, depth=1, device_put=False,
+                    stall_timeout_s=0.25)
+    next(pf)  # first batch arrives promptly
+    with pytest.raises(PrefetchStall, match="delivered nothing"):
+        # producer now sleeps 5s > 0.25s watchdog
+        while True:
+            next(pf)
+    pf.stop(timeout=6.0)
+
+
+def test_prefetcher_no_watchdog_by_default():
+    """stall_timeout_s=None keeps the original blocking behavior (no
+    spurious stalls on slow-but-healthy producers)."""
+    def produce():
+        time.sleep(0.1)
+        return None  # immediate clean end-of-stream
+
+    pf = Prefetcher(produce, depth=1, device_put=False)
+    with pytest.raises(StopIteration):
+        next(pf)
+    assert pf.stop() is True
+
+
+# ----------------------------------------------------------------------
+# SignalHandler as a context manager
+
+
+def test_signal_handler_context_restores_on_exception():
+    prev = signal.getsignal(signal.SIGHUP)
+    with pytest.raises(RuntimeError):
+        with SignalHandler() as h:
+            assert signal.getsignal(signal.SIGHUP) == h._handle
+            raise RuntimeError("driver loop blew up")
+    assert signal.getsignal(signal.SIGHUP) == prev
+
+
+def test_signal_handler_nesting_restores_previous_chain():
+    """Nested handlers unwind LIFO: the inner handler's exit restores
+    the OUTER handler, not the process default."""
+    base = signal.getsignal(signal.SIGINT)
+    with SignalHandler() as outer:
+        assert signal.getsignal(signal.SIGINT) == outer._handle
+        with SignalHandler() as inner:
+            assert signal.getsignal(signal.SIGINT) == inner._handle
+            os.kill(os.getpid(), signal.SIGINT)
+            assert inner.get_action() == SolverAction.STOP
+            assert outer.get_action() == SolverAction.NONE  # not leaked
+        assert signal.getsignal(signal.SIGINT) == outer._handle
+    assert signal.getsignal(signal.SIGINT) == base
+
+
+def test_signal_handler_restore_is_idempotent():
+    """A restore() followed by __exit__ (or a second restore) must not
+    clobber handlers installed in between."""
+    h = SignalHandler()
+    h.restore()
+
+    def custom(signum, frame):  # pragma: no cover - never delivered
+        pass
+
+    old = signal.signal(signal.SIGHUP, custom)
+    try:
+        h.restore()  # second restore: no-op, custom stays installed
+        assert signal.getsignal(signal.SIGHUP) is custom
+    finally:
+        signal.signal(signal.SIGHUP, old)
+
+
+# ----------------------------------------------------------------------
+# checkpoint: _atomic crash semantics, manifest, fallback + quarantine
+
+
+def test_atomic_partial_write_never_publishes(tmp_path):
+    """Kill-mid-write simulation: write_fn dies after partial bytes —
+    the target is never created and the temp file is cleaned up."""
+    target = str(tmp_path / "out.bin")
+
+    def dies_midway(p):
+        with open(p, "wb") as f:
+            f.write(b"partial")
+            raise OSError("killed mid-write")
+
+    with pytest.raises(OSError, match="killed mid-write"):
+        checkpoint._atomic(dies_midway, target)
+    assert not os.path.exists(target)
+    assert os.listdir(str(tmp_path)) == []  # no tmp litter
+
+
+def test_atomic_partial_write_keeps_previous_version(tmp_path):
+    target = str(tmp_path / "out.bin")
+    checkpoint._atomic(lambda p: open(p, "wb").write(b"good v1"), target)
+
+    def dies_midway(p):
+        with open(p, "wb") as f:
+            f.write(b"par")
+            raise OSError("killed")
+
+    with pytest.raises(OSError):
+        checkpoint._atomic(dies_midway, target)
+    with open(target, "rb") as f:
+        assert f.read() == b"good v1"  # old version intact, not truncated
+
+
+def _snapshot_at(solver, state, prefix, extra_steps=0):
+    for _ in range(extra_steps):
+        state, _ = solver.step(state, _batches(2))
+    return state, checkpoint.snapshot(solver, state, prefix)
+
+
+def test_snapshot_writes_manifest_and_verifies(tmp_path):
+    solver = _solver()
+    state = solver.init_state(seed=0)
+    state, _ = solver.step(state, _batches(3))
+    prefix = str(tmp_path / "ck")
+    model_path, state_path = checkpoint.snapshot(solver, state, prefix)
+    mpath = checkpoint.manifest_path_for(state_path)
+    assert os.path.exists(mpath)
+    checkpoint.verify_snapshot(state_path)  # passes clean
+
+    import json
+
+    with open(mpath) as f:
+        manifest = json.load(f)
+    assert set(manifest["files"]) == {
+        os.path.basename(model_path), os.path.basename(state_path)
+    }
+    for entry in manifest["files"].values():
+        assert entry["size"] > 0
+
+
+def test_verify_catches_bitflip_and_truncation(tmp_path):
+    solver = _solver()
+    state = solver.init_state(seed=0)
+    state, _ = solver.step(state, _batches(3))
+    prefix = str(tmp_path / "ck")
+    _, state_path = checkpoint.snapshot(solver, state, prefix)
+
+    # bit-flip (size unchanged — only the CRC can catch it)
+    from sparknet_tpu.runtime import chaos
+
+    chaos.corrupt_file(state_path)
+    with pytest.raises(checkpoint.SnapshotCorrupt, match="CRC32"):
+        checkpoint.verify_snapshot(state_path)
+    with pytest.raises(checkpoint.SnapshotCorrupt):
+        checkpoint.restore(solver, state_path)  # restore() verifies too
+
+    # rewrite clean, then truncate
+    _, state_path = checkpoint.snapshot(solver, state, prefix)
+    with open(state_path, "r+b") as f:
+        f.truncate(os.path.getsize(state_path) // 2)
+    with pytest.raises(checkpoint.SnapshotCorrupt, match="truncated"):
+        checkpoint.verify_snapshot(state_path)
+
+
+def test_restore_newest_valid_falls_back_and_quarantines(tmp_path):
+    solver = _solver()
+    state = solver.init_state(seed=0)
+    prefix = str(tmp_path / "ck")
+    state, _ = _snapshot_at(solver, state, prefix, extra_steps=2)
+    state, (___, newest) = _snapshot_at(solver, state, prefix, extra_steps=2)
+    assert len(checkpoint.find_snapshots(prefix)) == 2
+
+    from sparknet_tpu.runtime import chaos
+
+    chaos.corrupt_file(newest)
+    st, used = checkpoint.restore_newest_valid(solver, prefix)
+    assert used != newest
+    assert int(np.asarray(st.iter)) == 4  # the older, VALID snapshot
+    # the corrupt snapshot is quarantined: renamed out of the resume scan
+    assert not os.path.exists(newest)
+    assert os.path.exists(newest + ".corrupt")
+    assert checkpoint.find_snapshots(prefix) == [used]
+
+
+def test_restore_newest_valid_all_corrupt_raises(tmp_path):
+    solver = _solver()
+    state = solver.init_state(seed=0)
+    prefix = str(tmp_path / "ck")
+    _, (_m, state_path) = _snapshot_at(solver, state, prefix, extra_steps=1)
+
+    from sparknet_tpu.runtime import chaos
+
+    chaos.corrupt_file(state_path)
+    with pytest.raises(checkpoint.SnapshotCorrupt, match="all 1 candidates"):
+        checkpoint.restore_newest_valid(solver, prefix)
+    with pytest.raises(FileNotFoundError):
+        checkpoint.restore_newest_valid(solver, prefix)  # all quarantined
+
+
+def test_solver_mismatch_does_not_quarantine_healthy_snapshots(tmp_path):
+    """Only CORRUPTION quarantines.  A caller error (resuming with the
+    wrong solver type: different history layout) must not destructively
+    rename perfectly valid snapshots."""
+    solver = _solver()
+    state = solver.init_state(seed=0)
+    prefix = str(tmp_path / "ck")
+    _snapshot_at(solver, state, prefix, extra_steps=1)
+
+    sp = config.parse_solver_prototxt(
+        'base_lr: 0.05 lr_policy: "fixed" type: "ADAM"'
+    )
+    wrong = Solver(sp, net_param=config.parse_net_prototxt(NET))
+    with pytest.raises(checkpoint.SnapshotCorrupt, match="all 1 candidates"):
+        checkpoint.restore_newest_valid(wrong, prefix)
+    # the snapshot is still there, un-renamed: the RIGHT solver resumes
+    assert len(checkpoint.find_snapshots(prefix)) == 1
+    st, _ = checkpoint.restore_newest_valid(solver, prefix)
+    assert int(np.asarray(st.iter)) == 2
+
+
+def test_truncated_snapshot_without_manifest_still_falls_back(tmp_path):
+    """Pre-manifest (legacy) snapshots have no CRC file: a truncated one
+    fails DECODE, and the fallback must still engage."""
+    solver = _solver()
+    state = solver.init_state(seed=0)
+    prefix = str(tmp_path / "ck")
+    state, _ = _snapshot_at(solver, state, prefix, extra_steps=1)
+    state, (_m, newest) = _snapshot_at(solver, state, prefix, extra_steps=1)
+    os.unlink(checkpoint.manifest_path_for(newest))  # legacy snapshot
+    with open(newest, "r+b") as f:
+        f.truncate(16)
+    st, used = checkpoint.restore_newest_valid(solver, prefix)
+    assert used != newest and int(np.asarray(st.iter)) == 2  # 1 step x tau 2
+
+
+def test_snapshot_restore_roundtrip_still_exact(tmp_path):
+    """The manifest must not perturb the core invariant: snapshot ->
+    restore is bit-exact on params/history/iter."""
+    solver = _solver()
+    state = solver.init_state(seed=0)
+    state, _ = solver.step(state, _batches(3))
+    prefix = str(tmp_path / "ck")
+    _, state_path = checkpoint.snapshot(solver, state, prefix)
+    st = checkpoint.restore(_solver(), state_path)
+    assert int(np.asarray(st.iter)) == int(np.asarray(state.iter))
+    np.testing.assert_array_equal(
+        np.asarray(st.params["ip1"][0]), np.asarray(state.params["ip1"][0])
+    )
+
+
+# ----------------------------------------------------------------------
+# survivor-aware parameter averaging
+
+
+def _worker_data(n_workers, tau, seed=0):
+    rng = np.random.RandomState(seed)
+    xs, ys = [], []
+    for _ in range(n_workers):
+        xs.append(rng.randn(tau, 8, 6).astype(np.float32))
+        ys.append(rng.randint(0, 4, (tau, 8)).astype(np.float32))
+    return {"x": np.stack(xs), "label": np.stack(ys)}
+
+
+def test_survivor_averaging_excludes_dead_worker():
+    """round(live_mask=[1,0,1,1]): the average is the mean of the THREE
+    survivors' post-step params (manually recomputed), and the dead
+    worker's slot is overwritten with the survivor mean (it rejoins
+    healthy)."""
+    mesh = make_mesh({"dp": 4}, devices=jax.devices()[:4])
+    solver = _solver(momentum=0.0)
+    trainer = ParameterAveragingTrainer(solver, mesh)
+    st = trainer.init_state(seed=0)
+    data = _worker_data(4, 2, seed=11)
+    st, _ = trainer.round(
+        st, shard_leading(data, mesh), live_mask=[1, 0, 1, 1]
+    )
+    manual = []
+    for w in range(4):
+        ref = _solver(momentum=0.0)
+        rst = ref.init_state(seed=0)
+        rst, _ = ref.step(
+            rst,
+            {"x": data["x"][w], "label": data["label"][w]},
+            rng=jax.random.fold_in(jax.random.PRNGKey(0), w),
+        )
+        manual.append(np.asarray(rst.params["ip1"][0]))
+    survivors_mean = (manual[0] + manual[2] + manual[3]) / 3
+    got = np.asarray(st.params["ip1"][0])
+    for w in range(4):  # EVERY slot (dead one included) holds the mean
+        np.testing.assert_allclose(
+            got[w], survivors_mean, rtol=2e-4, atol=2e-6
+        )
+    # and the dead worker's replica did NOT poison the average
+    all_mean = sum(manual) / 4
+    assert not np.allclose(got[0], all_mean, rtol=1e-5, atol=1e-7)
+
+
+def test_survivor_averaging_immune_to_nan_garbage():
+    """A dead replica holding NaN (diverged/interrupted step) must not
+    poison survivors through the collective: where()-masking keeps the
+    average finite; 0*NaN would not."""
+    mesh = make_mesh({"dp": 4}, devices=jax.devices()[:4])
+    solver = _solver(momentum=0.0)
+    trainer = ParameterAveragingTrainer(solver, mesh)
+    st = trainer.init_state(seed=0)
+    host = jax.device_get(st)
+    poisoned = jax.tree_util.tree_map(lambda x: np.asarray(x).copy(), host)
+    for blob in poisoned.params.values():
+        for arr in blob:
+            arr[2] = np.nan  # worker 2's whole replica is garbage
+    st = shard_leading(poisoned, mesh)
+    st, _ = trainer.round(
+        st, shard_leading(_worker_data(4, 2, seed=13), mesh),
+        live_mask=[1, 1, 0, 1],
+    )
+    got = np.asarray(st.params["ip1"][0])
+    assert np.isfinite(got).all()
+    for w in range(1, 4):  # every slot got the same finite survivor mean
+        np.testing.assert_array_equal(got[w], got[0])
+
+
+def test_all_alive_mask_matches_default_round():
+    """live_mask=ones is numerically identical to the maskless round."""
+    mesh = make_mesh({"dp": 4}, devices=jax.devices()[:4])
+    data = _worker_data(4, 2, seed=12)
+
+    solver_a = _solver()
+    tr_a = ParameterAveragingTrainer(solver_a, mesh)
+    st_a = tr_a.init_state(seed=0)
+    st_a, _ = tr_a.round(st_a, shard_leading(data, mesh))
+
+    solver_b = _solver()
+    tr_b = ParameterAveragingTrainer(solver_b, mesh)
+    st_b = tr_b.init_state(seed=0)
+    st_b, _ = tr_b.round(
+        st_b, shard_leading(data, mesh), live_mask=np.ones(4)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(st_a.params["ip1"][0]), np.asarray(st_b.params["ip1"][0])
+    )
+
+
+def test_live_mask_validates_length():
+    mesh = make_mesh({"dp": 4}, devices=jax.devices()[:4])
+    trainer = ParameterAveragingTrainer(_solver(), mesh)
+    st = trainer.init_state(seed=0)
+    with pytest.raises(ValueError, match="live_mask"):
+        trainer.round(
+            st, shard_leading(_worker_data(4, 2), mesh), live_mask=[1, 1]
+        )
