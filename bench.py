@@ -36,9 +36,17 @@ Modes (env):
                         mesh; reports faults injected/survived, recovery
                         latency and the loss band vs the no-fault
                         baseline (CHAOS_r07.json artifact)
+  BENCH_MODE=pipeline   pipelined-round-feed A/B (data/round_feed.py
+                        RoundFeed): serial assemble->H2D->round loop vs
+                        the producer-thread overlapped loop, with a
+                        controllable-cost synthetic assembly leg plus a
+                        real cifar10_quick np.stack leg; reports
+                        serial/pipelined round times and the overlap
+                        efficiency against the ideal max(assembly, step)
+                        (PIPELINE_r08.json artifact)
 
 Modes can also be selected as ``python bench.py --mode=serve`` (flag
-wins over the env var).
+wins over the env var); an unknown mode is rejected.
   BENCH_PROFILE=1       also print the `caffe time`-style per-layer table
                         (stderr)
   BENCH_DTYPE=float32   reference numerics (default bfloat16 compute with
@@ -56,16 +64,24 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
+_MODES = ("train", "hostfeed", "scaling", "serve", "chaos", "pipeline")
 _MODE = os.environ.get("BENCH_MODE", "train")
 for _i, _a in enumerate(sys.argv[1:], start=1):
     if _a.startswith("--mode="):
         _MODE = _a.split("=", 1)[1]
     elif _a == "--mode":
         if _i + 1 >= len(sys.argv):
-            sys.exit("bench.py: --mode needs a value "
-                     "(train|hostfeed|scaling|serve|chaos)")
+            sys.exit("bench.py: --mode needs a value (%s)"
+                     % "|".join(_MODES))
         _MODE = sys.argv[_i + 1]
-if _MODE in ("scaling", "chaos"):
+if _MODE not in _MODES:
+    # reject BEFORE any backend/jax work: a typo'd mode must never fall
+    # through to the (expensive, chip-touching) default train run
+    sys.exit(
+        "bench.py: unknown mode %r (expected one of %s)"
+        % (_MODE, "|".join(_MODES))
+    )
+if _MODE in ("scaling", "chaos", "pipeline"):
     # these modes need >1 device; on a 1-chip host force the virtual CPU
     # mesh (the driver's multichip validation environment).  This must run
     # BEFORE the first backend use (XLA_FLAGS is parsed once per process),
@@ -839,6 +855,198 @@ def bench_chaos():
     print(json.dumps(out))
 
 
+def bench_pipeline():
+    """Serial vs pipelined round-loop A/B (``data/round_feed.py``).
+
+    Leg 1 (synthetic, the controllable-cost producer): assembly is a
+    deterministic sleep (BENCH_ASSEMBLY_MS; default 0.75x the measured
+    step — models host I/O wait: DB reads, decode, augmentation) plus
+    the real worker-stacked buffer fill.  Leg 2 (real): cifar10_quick
+    windows np.stack-assembled from real CIFAR-format minibatches — the
+    exact cifar_app loop shape on this box.
+
+    Each leg times the SAME round structure the apps run — per-round
+    device sync included (the apps read smoothed_loss every round) —
+    first with the serial assemble->place->round loop, then with the
+    RoundFeed producer thread overlapping round r+1's assembly+H2D
+    under round r's execute.  Reported against the ideal pipelined
+    round max(assembly, step) and the serial assembly + step:
+    overlap_efficiency = (serial - pipelined) / (serial - ideal), i.e.
+    the fraction of the hideable assembly cost actually hidden."""
+    import jax
+    import numpy as np
+
+    from sparknet_tpu import config as cfg, models
+    from sparknet_tpu.data import CifarLoader, RoundFeed
+    from sparknet_tpu.parallel import (
+        ParameterAveragingTrainer,
+        make_mesh,
+        shard_leading,
+    )
+    from sparknet_tpu.solver import Solver
+
+    workers = int(os.environ.get("BENCH_WORKERS", "2"))
+    tau = int(os.environ.get("BENCH_TAU", "2"))
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    rounds = int(os.environ.get("BENCH_ROUNDS", "5"))
+
+    import tempfile
+
+    data_dir = os.path.join(
+        tempfile.mkdtemp(prefix="bench_pipeline_"), "data"
+    )
+    CifarLoader.write_synthetic(data_dir, num_train=256, num_test=32, seed=8)
+    xs, ys = CifarLoader(data_dir).minibatches(batch, train=True)
+
+    def window(r):
+        """Deterministic worker-stacked tau-deep window for round r
+        (fresh arrays each call: the np.stack-assembly the apps do)."""
+        n = len(xs)
+        data = np.empty((workers, tau) + xs[0].shape, np.float32)
+        label = np.empty((workers, tau, batch), np.float32)
+        for w in range(workers):
+            for t in range(tau):
+                i = (r * workers * tau + w * tau + t) % n
+                data[w, t] = xs[i]
+                label[w, t] = ys[i]
+        return {"data": data, "label": label}
+
+    netp = cfg.replace_data_layers(
+        models.load_model("cifar10_quick"),
+        [(batch, 3, 32, 32), (batch,)],
+        [(batch, 3, 32, 32), (batch,)],
+    )
+    solver = Solver(models.load_model_solver("cifar10_quick"), net_param=netp)
+    mesh = make_mesh({"dp": workers}, devices=jax.devices()[:workers])
+    trainer = ParameterAveragingTrainer(solver, mesh)
+
+    def timed_rounds(next_batch):
+        """Mean round seconds: place->round->sync per round, state
+        re-initialized so every leg runs the identical program."""
+        state = trainer.init_state(seed=0)
+        state, losses = trainer.round(state, shard_leading(window(0), mesh))
+        jax.block_until_ready(losses)  # compile + warm outside the clock
+        t0 = time.perf_counter()
+        for r in range(rounds):
+            state, losses = trainer.round(state, next_batch(r))
+            jax.block_until_ready(losses)  # the apps' per-round sync
+        return (time.perf_counter() - t0) / rounds
+
+    # step alone: windows prebuilt, so the timed loop is place+round+sync.
+    # One throwaway pass warms the whole path (first-touch page faults,
+    # allocator steady state — this 2-core box shows large cold-start
+    # variance), then best-of-2 is the step estimate the ideal uses.
+    ws = [window(r) for r in range(rounds)]
+    step_fn = lambda r: shard_leading(ws[r], mesh)  # noqa: E731
+    timed_rounds(step_fn)
+    step_s = min(timed_rounds(step_fn), timed_rounds(step_fn))
+
+    assembly_ms_env = os.environ.get("BENCH_ASSEMBLY_MS")
+    assembly_sleep_s = (
+        float(assembly_ms_env) / 1e3
+        if assembly_ms_env is not None
+        else 0.75 * step_s
+    )
+
+    def synth_assemble(r, out):
+        time.sleep(assembly_sleep_s)  # the controllable host-I/O cost
+        return window(r)
+
+    def real_assemble(r, out):
+        return window(r)
+
+    def measure(assemble, label):
+        # assembly alone (host only, no device work)
+        t0 = time.perf_counter()
+        for r in range(rounds):
+            assemble(r, None)
+        asm_s = (time.perf_counter() - t0) / rounds
+        # serial: assemble + place on the training loop, then the round
+        serial_s = timed_rounds(
+            lambda r: shard_leading(assemble(r, None), mesh)
+        )
+        # pipelined: RoundFeed producer overlaps assembly+H2D
+        feed = RoundFeed(assemble, mesh=mesh, num_rounds=rounds + 1)
+        try:
+            state = trainer.init_state(seed=0)
+            state, losses = trainer.round(state, feed.next_round(0))
+            jax.block_until_ready(losses)  # warm; producer runs ahead
+            t0 = time.perf_counter()
+            for r in range(1, rounds + 1):
+                state, losses = trainer.round(state, feed.next_round(r))
+                jax.block_until_ready(losses)
+            pipe_s = (time.perf_counter() - t0) / rounds
+        finally:
+            feed.stop()
+        ideal_s = max(asm_s, step_s)
+        denom = serial_s - ideal_s
+        # efficiency is only meaningful when there is a non-trivial
+        # hideable cost; below 2% of the round it is pure noise division
+        eff = (
+            (serial_s - pipe_s) / denom
+            if denom > 0.02 * serial_s
+            else None
+        )
+        print(
+            "pipeline[%s]: assembly %.1f ms + step %.1f ms | serial "
+            "round %.1f ms -> pipelined %.1f ms (ideal %.1f ms, overlap "
+            "efficiency %s)"
+            % (
+                label, asm_s * 1e3, step_s * 1e3, serial_s * 1e3,
+                pipe_s * 1e3, ideal_s * 1e3,
+                "%.2f" % eff if eff is not None else "n/a",
+            ),
+            file=sys.stderr,
+        )
+        return {
+            "assembly_ms": round(asm_s * 1e3, 2),
+            "serial_round_ms": round(serial_s * 1e3, 2),
+            "pipelined_round_ms": round(pipe_s * 1e3, 2),
+            "ideal_round_ms": round(ideal_s * 1e3, 2),
+            "speedup": round(serial_s / pipe_s, 3),
+            "overlap_efficiency": (
+                round(eff, 3) if eff is not None else None
+            ),
+        }
+
+    synth = measure(synth_assemble, "synthetic")
+    real = measure(real_assemble, "real_cifar10_quick")
+
+    out = {
+        "metric": "pipeline_overlap_speedup",
+        "value": synth["speedup"],
+        "unit": "x serial round time (synthetic leg)",
+        "vs_baseline": synth["speedup"],  # done-bar: > 1.0
+        "platform": jax.devices()[0].platform,
+        "workers": workers,
+        "tau": tau,
+        "batch": batch,
+        "rounds": rounds,
+        "step_ms": round(step_s * 1e3, 2),
+        "assembly_ms": synth["assembly_ms"],
+        "serial_round_ms": synth["serial_round_ms"],
+        "pipelined_round_ms": synth["pipelined_round_ms"],
+        "ideal_round_ms": synth["ideal_round_ms"],
+        "overlap_efficiency": synth["overlap_efficiency"],
+        "real": real,
+        "note": "RoundFeed A/B on cifar10_quick over the virtual dp "
+        "mesh: serial = per-round host assembly + sharded device_put + "
+        "round + sync (the pre-round-8 app loop); pipelined = the same "
+        "round with round r+1's assembly+H2D on the RoundFeed producer "
+        "thread under round r's execute; synthetic leg's assembly cost "
+        "is a deterministic sleep (host-I/O stand-in, "
+        "BENCH_ASSEMBLY_MS) plus the real buffer fill; "
+        "overlap_efficiency = (serial - pipelined)/(serial - "
+        "max(assembly, step)) — 1.0 means every hideable assembly "
+        "millisecond was hidden; null when the hideable cost is under "
+        "2% of the round (on this CPU box the real cifar10_quick leg's "
+        "np.stack assembly is sub-ms against a ~1s step, so its A/B is "
+        "bounded by run-to-run noise — the synthetic leg is the "
+        "controlled measurement)",
+    }
+    print(json.dumps(out))
+
+
 def main():
     if _MODE == "scaling":
         bench_scaling()
@@ -851,6 +1059,9 @@ def main():
         return
     if _MODE == "chaos":
         bench_chaos()
+        return
+    if _MODE == "pipeline":
+        bench_pipeline()
         return
     # the remote-TPU tunnel occasionally drops a request mid-run; one
     # retry keeps the recorded benchmark from dying on a transient

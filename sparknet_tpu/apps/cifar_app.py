@@ -33,13 +33,24 @@ def main(argv=None) -> int:
     parser.add_argument("--test_every", type=int, default=10)  # CifarApp.scala:101
     parser.add_argument("--batch", type=int, default=100)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--serial_feed", action="store_true",
+        help="disable the pipelined round feed (assemble+H2D on the "
+        "training loop) — for relay-degraded links where overlapped "
+        "transfers collapse throughput (PERF.md)",
+    )
     args = parser.parse_args(argv)
 
     import jax
 
     from sparknet_tpu import models
     from sparknet_tpu.apps.scores import primary_accuracy
-    from sparknet_tpu.data import CifarLoader, MinibatchSampler
+    from sparknet_tpu.data import (
+        CifarLoader,
+        MinibatchSampler,
+        RoundFeed,
+        stack_windows,
+    )
     from sparknet_tpu.parallel import (
         ParameterAveragingTrainer,
         local_worker_slice,
@@ -125,15 +136,28 @@ def main(argv=None) -> int:
             log.log(f"test output {name} = {scores[name] / num_test_batches:.4f}")
         return primary_accuracy(scores) / num_test_batches
 
-    for r in range(args.rounds):
-        if r % args.test_every == 0:  # test before train, CifarApp.scala:101
-            log.log(f"round {r}, accuracy {evaluate(r):.4f}")
-        windows = [s.next_window() for s in samplers]
-        stacked = {
-            k: np.stack([w[k] for w in windows]) for k in windows[0]
-        }
-        state, _ = trainer.round(state, shard_leading_global(stacked, mesh))
-        log.log(f"round {r} trained, smoothed_loss {solver.smoothed_loss:.4f}")
+    # pipelined round feed: round r+1's windows are drawn, stacked into
+    # recycled buffers and device_put on a producer thread while round r
+    # executes (RoundFeed; --serial_feed restores the old serial path
+    # with identical numerics)
+    feed = RoundFeed(
+        lambda r, out: stack_windows(
+            [s.next_window() for s in samplers], out
+        ),
+        place=lambda host: shard_leading_global(host, mesh),
+        pipelined=not args.serial_feed,
+        num_rounds=args.rounds,
+    )
+    try:
+        for r in range(args.rounds):
+            if r % args.test_every == 0:  # test before train, CifarApp.scala:101
+                log.log(f"round {r}, accuracy {evaluate(r):.4f}")
+            state, _ = trainer.round(state, feed.next_round(r))
+            log.log(
+                f"round {r} trained, smoothed_loss {solver.smoothed_loss:.4f}"
+            )
+    finally:
+        feed.stop()
 
     log.log(f"final accuracy {evaluate():.4f}")
     return 0

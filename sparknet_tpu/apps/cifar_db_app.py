@@ -53,12 +53,17 @@ def main(argv=None) -> int:
     parser.add_argument("--tau", type=int, default=10)
     parser.add_argument("--batch", type=int, default=100)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--serial_feed", action="store_true",
+        help="disable the pipelined round feed (PERF.md: relay-degraded "
+        "links)",
+    )
     args = parser.parse_args(argv)
 
     import jax
 
     from sparknet_tpu import models, runtime
-    from sparknet_tpu.data import CifarLoader
+    from sparknet_tpu.data import CifarLoader, RoundFeed, stack_windows
     from sparknet_tpu.io import caffemodel
     from sparknet_tpu.parallel import (
         ParameterAveragingTrainer,
@@ -106,7 +111,9 @@ def main(argv=None) -> int:
     state = trainer.init_state(seed=args.seed)
     log.log("nets ready")
 
-    for r in range(args.rounds):
+    def assemble(r, out):
+        # reader-thread pulls + worker stack, on the RoundFeed producer:
+        # round r+1's DB reads and H2D overlap round r's execute
         windows = []
         for p in pipes:
             batches = [p.next() for _ in range(args.tau)]
@@ -116,9 +123,22 @@ def main(argv=None) -> int:
                     "label": np.stack([b[1] for b in batches]),
                 }
             )
-        stacked = {k: np.stack([w[k] for w in windows]) for k in windows[0]}
-        state, _ = trainer.round(state, shard_leading(stacked, mesh))
-        log.log(f"round {r} trained, smoothed_loss {solver.smoothed_loss:.4f}")
+        return stack_windows(windows, out)
+
+    feed = RoundFeed(
+        assemble,
+        mesh=mesh,
+        pipelined=not args.serial_feed,
+        num_rounds=args.rounds,
+    )
+    try:
+        for r in range(args.rounds):
+            state, _ = trainer.round(state, feed.next_round(r))
+            log.log(
+                f"round {r} trained, smoothed_loss {solver.smoothed_loss:.4f}"
+            )
+    finally:
+        feed.stop()
 
     # eval from the test DB
     nb = 2

@@ -79,6 +79,11 @@ def main(argv=None) -> int:
     parser.add_argument("--classes", type=int, default=1000)
     parser.add_argument("--no_mirror", action="store_true")
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--serial_feed", action="store_true",
+        help="disable the pipelined round feed (assemble+H2D on the "
+        "training loop) — for relay-degraded links (PERF.md)",
+    )
     args = parser.parse_args(argv)
 
     import jax
@@ -87,8 +92,10 @@ def main(argv=None) -> int:
     from sparknet_tpu.data import (
         ImageNetLoader,
         MinibatchSampler,
+        RoundFeed,
         compute_mean,
         reduce_mean_sums,
+        stack_windows,
         transforms,
         write_synthetic_imagenet,
     )
@@ -291,16 +298,28 @@ def main(argv=None) -> int:
             )
         return primary_accuracy(scores) / max(1, num_test_used)
 
-    for r in range(args.rounds):
-        if r % args.test_every == 0:  # test-then-train, ImageNetApp.scala:118
-            log.log(f"{evaluate(r) * 100:.2f}% accuracy", i=r)
-        log.log("training", i=r)
-        windows = [s.next_window() for s in samplers]
-        stacked = {k: np.stack([w[k] for w in windows]) for k in windows[0]}
-        state, _ = trainer.round(state, shard_leading_global(stacked, mesh))
-        log.log(
-            f"trained, smoothed_loss {solver.smoothed_loss:.4f}", i=r
-        )
+    # pipelined round feed: the uint8 windows for round r+1 are stacked
+    # into recycled buffers and device_put on a producer thread while
+    # round r executes (--serial_feed restores the serial path)
+    feed = RoundFeed(
+        lambda r, out: stack_windows(
+            [s.next_window() for s in samplers], out
+        ),
+        place=lambda host: shard_leading_global(host, mesh),
+        pipelined=not args.serial_feed,
+        num_rounds=args.rounds,
+    )
+    try:
+        for r in range(args.rounds):
+            if r % args.test_every == 0:  # test-then-train, ImageNetApp.scala:118
+                log.log(f"{evaluate(r) * 100:.2f}% accuracy", i=r)
+            log.log("training", i=r)
+            state, _ = trainer.round(state, feed.next_round(r))
+            log.log(
+                f"trained, smoothed_loss {solver.smoothed_loss:.4f}", i=r
+            )
+    finally:
+        feed.stop()
 
     acc = evaluate()
     log.log(f"final accuracy {acc * 100:.2f}%")

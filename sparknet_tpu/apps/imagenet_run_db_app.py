@@ -60,12 +60,18 @@ def main(argv=None) -> int:
     parser.add_argument("--resume", action="store_true",
                         help="continue from the newest snapshot")
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--serial_feed", action="store_true",
+        help="disable the pipelined round feed (PERF.md: relay-degraded "
+        "links)",
+    )
     args = parser.parse_args(argv)
 
     import jax
 
     from sparknet_tpu import config as cfg, models, runtime
     from sparknet_tpu.apps.scores import primary_accuracy
+    from sparknet_tpu.data import RoundFeed, stack_windows
     from sparknet_tpu.io import caffemodel, checkpoint
     from sparknet_tpu.parallel import (
         ParameterAveragingTrainer,
@@ -185,10 +191,7 @@ def main(argv=None) -> int:
         )
         return primary_accuracy(scores) / max(1, num_test_mbs)
 
-    for r in range(start_round, start_round + args.rounds):
-        if r % args.test_every == 0:
-            log.log(f"{evaluate() * 100:.2f}% accuracy", i=r)
-        log.log("training", i=r)
+    def assemble(r, out):
         windows = []
         for pipe in pipes:
             batches = [pipe.next() for _ in range(args.tau)]
@@ -198,13 +201,33 @@ def main(argv=None) -> int:
                     "label": np.stack([b[1] for b in batches]),
                 }
             )
-        stacked = {k: np.stack([w[k] for w in windows]) for k in windows[0]}
-        state, _ = trainer.round(state, shard_leading(stacked, mesh))
-        log.log(f"trained, smoothed_loss {solver.smoothed_loss:.4f}", i=r)
-        if args.snapshot_every and (r + 1) % args.snapshot_every == 0:
-            st = first_worker(jax.device_get(state))
-            model_path, state_path = checkpoint.snapshot(solver, st, prefix)
-            log.log(f"snapshot -> {model_path}", i=r)
+        return stack_windows(windows, out)
+
+    # pipelined feed, resume-aware: rounds are absolute, so a resumed
+    # run's producer starts at start_round and the reader pipelines pick
+    # up where the DB cursors sit (--serial_feed: old serial path)
+    feed = RoundFeed(
+        assemble,
+        mesh=mesh,
+        pipelined=not args.serial_feed,
+        start_round=start_round,
+        num_rounds=args.rounds,
+    )
+    try:
+        for r in range(start_round, start_round + args.rounds):
+            if r % args.test_every == 0:
+                log.log(f"{evaluate() * 100:.2f}% accuracy", i=r)
+            log.log("training", i=r)
+            state, _ = trainer.round(state, feed.next_round(r))
+            log.log(f"trained, smoothed_loss {solver.smoothed_loss:.4f}", i=r)
+            if args.snapshot_every and (r + 1) % args.snapshot_every == 0:
+                st = first_worker(jax.device_get(state))
+                model_path, state_path = checkpoint.snapshot(
+                    solver, st, prefix
+                )
+                log.log(f"snapshot -> {model_path}", i=r)
+    finally:
+        feed.stop()
 
     acc = evaluate()
     log.log(f"final accuracy {acc * 100:.2f}%")

@@ -21,4 +21,9 @@ from sparknet_tpu.data.imagenet import (  # noqa: F401
 from sparknet_tpu.data.sampler import MinibatchSampler  # noqa: F401
 from sparknet_tpu.data.transformer import DataTransformer  # noqa: F401
 from sparknet_tpu.data import transforms  # noqa: F401
-from sparknet_tpu.data.prefetch import Prefetcher, device_prefetch  # noqa: F401
+from sparknet_tpu.data.prefetch import (  # noqa: F401
+    Prefetcher,
+    PrefetchStall,
+    device_prefetch,
+)
+from sparknet_tpu.data.round_feed import RoundFeed, stack_windows  # noqa: F401

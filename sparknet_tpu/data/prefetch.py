@@ -102,6 +102,13 @@ class Prefetcher:
         return self
 
     def __next__(self):
+        if self._stopped:
+            # stop() drained the queue and nothing more is coming: the
+            # stream is over NOW.  Without this, a stall_timeout_s
+            # consumer would wait out the whole watchdog window and then
+            # raise a misleading PrefetchStall on a deliberately-stopped
+            # prefetcher.
+            raise StopIteration
         if self._done:
             if self._error is not None:
                 raise self._error

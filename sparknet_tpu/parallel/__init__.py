@@ -26,9 +26,11 @@ from sparknet_tpu.parallel.trainers import (  # noqa: F401
     AllReduceTrainer,
     ParameterAveragingTrainer,
     first_worker,
+    leading_sharding,
     local_worker_slice,
     replicate,
     replicate_global,
+    replicated_sharding,
     shard_leading,
     shard_leading_global,
 )

@@ -22,6 +22,7 @@ multi-host pod (see ``mesh.initialize_distributed``).
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, Optional
 
 import jax
@@ -40,10 +41,27 @@ from sparknet_tpu.utils.rngs import train_key
 tree_map = jax.tree_util.tree_map
 
 
+@functools.lru_cache(maxsize=256)
+def leading_sharding(mesh: Mesh, axis: str = "dp") -> NamedSharding:
+    """The leading-axis placement ``NamedSharding(mesh, P(axis))``,
+    built ONCE per (mesh, axis) — the training loops place a batch with
+    this every round, and rebuilding the sharding object per round is
+    avoidable host work on the hot path (meshes are few and long-lived,
+    so the cache stays tiny)."""
+    return NamedSharding(mesh, P(axis))
+
+
+@functools.lru_cache(maxsize=256)
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated placement ``NamedSharding(mesh, P())``, cached
+    like ``leading_sharding``."""
+    return NamedSharding(mesh, P())
+
+
 def replicate(tree, mesh: Mesh):
     """Place a pytree fully replicated over the mesh (no new axes; the
     inverse is a no-op — just use the tree)."""
-    return jax.device_put(tree, NamedSharding(mesh, P()))
+    return jax.device_put(tree, replicated_sharding(mesh))
 
 
 def first_worker(stacked_tree):
@@ -56,7 +74,7 @@ def first_worker(stacked_tree):
 def shard_leading(tree, mesh: Mesh, axis: str = "dp"):
     """Shard every leaf's leading dimension over ``axis`` (the per-worker
     stacking used by the averaging trainer and for per-worker batches)."""
-    return jax.device_put(tree, NamedSharding(mesh, P(axis)))
+    return jax.device_put(tree, leading_sharding(mesh, axis))
 
 
 def local_worker_slice(mesh: Mesh, axis: str = "dp") -> slice:
@@ -87,7 +105,7 @@ def shard_leading_global(tree_local, mesh: Mesh, axis: str = "dp"):
     leading dim and degrades to ``shard_leading``."""
     if jax.process_count() == 1:
         return shard_leading(tree_local, mesh, axis)
-    sharding = NamedSharding(mesh, P(axis))
+    sharding = leading_sharding(mesh, axis)
     n = mesh.shape[axis]
 
     def mk(x):
@@ -102,7 +120,7 @@ def shard_leading_global(tree_local, mesh: Mesh, axis: str = "dp"):
 def replicate_global(tree, mesh: Mesh):
     """Fully-replicated placement that also works multi-host (every process
     passes the same host value — the initial weight broadcast semantics)."""
-    sharding = NamedSharding(mesh, P())
+    sharding = replicated_sharding(mesh)
     if jax.process_count() == 1:
         return jax.device_put(tree, sharding)
 
@@ -170,6 +188,15 @@ class ParameterAveragingTrainer:
             st = TrainState(avg_params, avg_stats, st.history, st.iter)
             return tree_map(lambda x: x[None], st), losses[None]
 
+        # state AND batches are donated: the consumed round's batch
+        # buffers are recycled on device (XLA reuses them as scratch /
+        # for outputs) instead of coexisting with round r+1's incoming
+        # batch — with the pipelined RoundFeed keeping a batch in
+        # flight, that halves steady-state batch memory.  Callers pass
+        # host numpy batches (safe to reuse: the jit places a fresh
+        # device buffer and donates THAT) or a freshly-placed device
+        # batch per round (the apps/RoundFeed pattern); a device batch
+        # is deleted by the round that consumes it.
         self._round = jax.jit(
             shard_map(
                 round_body,
@@ -177,9 +204,12 @@ class ParameterAveragingTrainer:
                 in_specs=(P(axis), P(axis), P(), P(axis)),
                 out_specs=(P(axis), P(axis)),
             ),
-            donate_argnums=(0,),
+            donate_argnums=(0, 1),
         )
-        self._live_ones = None  # lazily-placed all-alive mask
+        # per-mask placed live masks, cached: the chaos/degraded loops
+        # pass the SAME mask for many consecutive rounds, and the
+        # all-alive default mask is placed exactly once
+        self._live_cache: Dict[bytes, jax.Array] = {}
 
         def eval_body(state, batches, counts):
             # heterogeneous partitions: every worker's batches are padded
@@ -217,7 +247,7 @@ class ParameterAveragingTrainer:
             return shard_leading(stacked, self.mesh, self.axis)
         # multi-host: identical init everywhere; each process materializes
         # its local workers' shards from the broadcast value
-        sharding = NamedSharding(self.mesh, P(self.axis))
+        sharding = leading_sharding(self.mesh, self.axis)
 
         def mk(x):
             x = np.asarray(x)
@@ -229,19 +259,31 @@ class ParameterAveragingTrainer:
         return tree_map(mk, st)
 
     def _place_live(self, live_mask) -> jax.Array:
-        """Place a host (num_workers,) 0/1 mask over the dp axis."""
+        """Place a host (num_workers,) 0/1 mask over the dp axis.
+        Cached per distinct mask value — the loops pass the same mask
+        round after round (all-alive, or one fixed fault pattern), so
+        the placement happens once, not once per round."""
         live = np.asarray(live_mask, np.float32).reshape(-1)
         if live.shape[0] != self.num_workers:
             raise ValueError(
                 f"live_mask has {live.shape[0]} entries, mesh has "
                 f"{self.num_workers} workers"
             )
-        sharding = NamedSharding(self.mesh, P(self.axis))
+        key = live.tobytes()
+        cached = self._live_cache.get(key)
+        if cached is not None:
+            return cached
+        sharding = leading_sharding(self.mesh, self.axis)
         if jax.process_count() > 1:
-            return jax.make_array_from_callback(
+            placed = jax.make_array_from_callback(
                 live.shape, sharding, lambda idx: live[idx]
             )
-        return jax.device_put(live, sharding)
+        else:
+            placed = jax.device_put(live, sharding)
+        if len(self._live_cache) >= 64:  # masks are few; never unbounded
+            self._live_cache.clear()
+        self._live_cache[key] = placed
+        return placed
 
     def round(
         self,
@@ -260,13 +302,8 @@ class ParameterAveragingTrainer:
         means all alive (identical numerics to the unmasked round)."""
         rng = rng if rng is not None else train_key(0)
         if live_mask is None:
-            if self._live_ones is None:
-                self._live_ones = self._place_live(
-                    np.ones((self.num_workers,), np.float32)
-                )
-            live = self._live_ones
-        else:
-            live = self._place_live(live_mask)
+            live_mask = np.ones((self.num_workers,), np.float32)
+        live = self._place_live(live_mask)  # cached per mask value
         state, losses = self._round(state, batches, rng, live)
         # recorded lazily: smoothed_loss pulls the worker-mean of the
         # addressable shards on read (Solver._drain_losses) — no
@@ -293,7 +330,7 @@ class ParameterAveragingTrainer:
         counts = np.asarray(counts, np.int32)
         if jax.process_count() > 1 and counts.shape[0] == self.num_workers:
             # pass the GLOBAL counts on every host; place like the state
-            sharding = NamedSharding(self.mesh, P(self.axis))
+            sharding = leading_sharding(self.mesh, self.axis)
             counts_arr = jax.make_array_from_callback(
                 counts.shape, sharding, lambda idx: counts[idx]
             )
@@ -367,6 +404,13 @@ class AllReduceTrainer:
             out_shardings=(state_shardings, repl),
         )
         self._batch_sharding = batch_sharding
+
+    @property
+    def batch_sharding(self):
+        """The (tau, global_batch) placement ``step()`` applies — public
+        for feeds that issue the put on a producer thread (RoundFeed);
+        ``step()`` on an already-so-placed batch re-puts as a no-op."""
+        return self._batch_sharding
 
     def _param_shardings(self, params):
         """TP policy: shard the output-channel dim of large param blobs over

@@ -142,16 +142,19 @@ def corrupt_file(path: str, seed: int = 0) -> None:
 
 
 class _Feed:
-    """Deterministic per-round window builder behind a Prefetcher, with
-    storage faults (transient errors healed by retry) and stalls
-    (producer wedges past the watchdog) injected per plan."""
+    """Deterministic per-round window builder behind the pipelined
+    ``RoundFeed`` executor (assembly + dp-sharded device_put on the
+    producer thread — the same executor the apps and ``cli train``
+    run), with storage faults (transient errors healed by retry) and
+    stalls (producer wedges past the watchdog) injected per plan."""
 
-    def __init__(self, plan: FaultPlan, xs, ys, counters, events,
+    def __init__(self, plan: FaultPlan, xs, ys, counters, events, mesh,
                  fault_state=None):
         self.plan = plan
         self.xs, self.ys = xs, ys
         self.counters = counters
         self.events = events
+        self.mesh = mesh
         # fault state is SHARED across prefetcher/feed rebuilds (resume
         # replays rounds by absolute index; a per-round fault fires once)
         fault_state = fault_state if fault_state is not None else {}
@@ -159,7 +162,7 @@ class _Feed:
         fault_state.setdefault("stalls", set(plan.stall_rounds))
         self._faults = fault_state["faults"]
         self._stalls = fault_state["stalls"]
-        self._pf = None
+        self._rf = None
         self._policy = _retry.RetryPolicy(
             max_attempts=6, base_s=0.005, cap_s=0.02, budget_s=2.0
         )
@@ -206,51 +209,45 @@ class _Feed:
         return out
 
     def _spawn(self, start_r: int):
-        from sparknet_tpu.data.prefetch import Prefetcher
+        from sparknet_tpu.data.round_feed import RoundFeed
 
-        # the round cursor is LOCAL to this prefetcher generation: a
-        # producer thread that outlives stop() (a stall longer than the
-        # reap timeout) keeps bumping ITS cursor, never the rebuilt
-        # generation's — no round can be silently skipped
-        cur = [start_r]
-
-        def produce():
-            out = self._produce_round(cur[0])
-            cur[0] += 1
-            return out
-
-        self._pf = Prefetcher(
-            produce,
+        # RoundFeed keeps the round cursor LOCAL to each producer
+        # generation (a thread that outlives stop() — a stall longer
+        # than the reap timeout — keeps bumping ITS cursor, never the
+        # rebuilt generation's: no round can be silently skipped) and
+        # issues the dp-sharded device_put on the producer thread
+        self._rf = RoundFeed(
+            lambda r, out: self._produce_round(r),
+            mesh=self.mesh,
             depth=2,
-            device_put=False,
             stall_timeout_s=self.plan.stall_timeout_s,
+            start_round=start_r,
         )
 
     def next_round(self, r: int):
-        """The (workers, tau, ...) host batches for absolute round ``r``,
-        surviving producer stalls by rebuilding the prefetcher.  A stall
-        counts as survived once the round is DELIVERED — whether the
-        watchdog fired and the prefetcher was rebuilt, or the stall was
+        """The dp-PLACED (workers, tau, ...) batches for absolute round
+        ``r``, surviving producer stalls by restarting the feed.  A
+        stall counts as survived once the round is DELIVERED — whether
+        the watchdog fired and the feed was restarted, or the stall was
         absorbed by the prefetch depth (the producer was far enough
         ahead that training never noticed)."""
-        from sparknet_tpu.data.prefetch import PrefetchStall
+        from sparknet_tpu.data.round_feed import PrefetchStall
 
-        if self._pf is None:
+        if self._rf is None:
             self._spawn(r)
         while True:
             try:
-                out = next(self._pf)
+                out = self._rf.next_round(r)
                 break
             except PrefetchStall:
-                exited = self._pf.stop()
+                exited = self._rf.restart(r)
                 self.counters["watchdog_fires"] = (
                     self.counters.get("watchdog_fires", 0) + 1
                 )
                 self.events.append(
-                    "round %d: watchdog fired; prefetcher stopped "
+                    "round %d: watchdog fired; round feed stopped "
                     "(thread exited: %s); rebuilding" % (r, exited)
                 )
-                self._spawn(r)
         if r in self.plan.stall_rounds and r not in self._stalls:
             # this round's planned stall has been consumed and the round
             # still arrived
@@ -262,9 +259,9 @@ class _Feed:
         return out
 
     def close(self):
-        if self._pf is not None:
-            self._pf.stop()
-            self._pf = None
+        if self._rf is not None:
+            self._rf.stop()
+            self._rf = None
 
 
 def run_chaos(
@@ -350,12 +347,11 @@ def run_chaos(
         "storage_injected": 0, "storage_survived": 0,
         "stalls_injected": 0, "stalls_survived": 0,
     }
-    feed = _Feed(base_plan, xs, ys, base_counters, events)
+    feed = _Feed(base_plan, xs, ys, base_counters, events, mesh)
     state = trainer.init_state(seed=plan.seed)
     losses = None
     for r in range(plan.rounds):
-        batches = shard_leading(feed.next_round(r), mesh)
-        state, losses = trainer.round(state, batches)
+        state, losses = trainer.round(state, feed.next_round(r))
     feed.close()
     baseline_loss = final_round_loss(losses)
     note(f"baseline (no faults): final-round loss {baseline_loss:.4f}")
@@ -366,7 +362,7 @@ def run_chaos(
         "stalls_injected": 0, "stalls_survived": 0,
     }
     fault_state: Dict = {}
-    feed = _Feed(plan, xs, ys, counters, events, fault_state)
+    feed = _Feed(plan, xs, ys, counters, events, mesh, fault_state)
     prefix = os.path.join(workdir, "chaos_ckpt")
     state = trainer.init_state(seed=plan.seed)
     losses = None
@@ -393,7 +389,7 @@ def run_chaos(
         pre-preemption loop and the post-resume replay — fault
         accounting must stay identical in both)."""
         nonlocal state, losses
-        batches = shard_leading(fd.next_round(r), mesh)
+        batches = fd.next_round(r)  # placed by the pipelined feed
         mask = live_mask_for(r)
         if mask is not None and r == plan.dead_from_round:
             counters["dead_worker_injected"] = 1
@@ -470,7 +466,7 @@ def run_chaos(
                 preempted_at + 1 - start_round,
             )
         )
-        feed = _Feed(plan, xs, ys, counters, events, fault_state)
+        feed = _Feed(plan, xs, ys, counters, events, mesh, fault_state)
         for r in range(start_round, plan.rounds):
             run_round(feed, r)
         feed.close()

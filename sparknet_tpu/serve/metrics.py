@@ -110,6 +110,10 @@ class Histogram:
         self._ring: List[float] = []
         self._ring_cap = int(reservoir)
         self._ring_pos = 0
+        # sorted view of the ring, built lazily on the first quantile
+        # read and kept until the next observation — a scrape reading
+        # p50/p95/p99 sorts ONCE, not once per quantile
+        self._sorted: Optional[List[float]] = None
 
     def observe(self, v: float) -> None:
         v = float(v)
@@ -128,6 +132,7 @@ class Histogram:
             else:
                 self._ring[self._ring_pos] = v
                 self._ring_pos = (self._ring_pos + 1) % self._ring_cap
+            self._sorted = None  # invalidate the cached sorted view
 
     @property
     def count(self) -> int:
@@ -145,9 +150,14 @@ class Histogram:
 
     def quantile(self, q: float) -> float:
         """q in [0, 1] over the recent-observation reservoir (0.0 when
-        empty); nearest-rank on the sorted window."""
+        empty); nearest-rank on the sorted window.  The sort happens at
+        most once per observation batch: consecutive quantile reads
+        (p50/p95/p99 in one scrape) share the cached sorted view, which
+        ``observe`` invalidates."""
         with self._lock:
-            window = sorted(self._ring)
+            if self._sorted is None:
+                self._sorted = sorted(self._ring)
+            window = self._sorted  # replaced, never mutated, on observe
         if not window:
             return 0.0
         idx = min(len(window) - 1, max(0, int(q * len(window))))

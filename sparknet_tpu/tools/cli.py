@@ -176,46 +176,72 @@ def cmd_train(args) -> int:
     # per-round device_get of state.iter would sync the async dispatch
     # queue (and degrade the put lane on the axon relay — PERF.md)
     it = int(jax.device_get(state.iter))
+    # pipelined round feed: the next window is assembled and device_put
+    # on a producer thread while the current one trains (--serial_feed
+    # restores assemble-then-put on this loop, identical numerics)
+    from sparknet_tpu.data import RoundFeed
+
+    def assemble(r, out):
+        return (
+            sampler.next_window()
+            if sampler
+            else _synthetic_batches(solver.net, args.tau)
+        )
+
+    feed = RoundFeed(
+        assemble,
+        sharding=trainer.batch_sharding if trainer is not None else None,
+        pipelined=not args.serial_feed,
+        num_rounds=max(0, -(-(max_iter - it) // args.tau)),
+    )
+    r = 0
     # the context manager guarantees the previous handler chain comes
     # back even when a step raises (no leaked handlers on exceptions)
     with SignalHandler(
         sigint_effect=effects[args.sigint_effect],
         sighup_effect=effects[args.sighup_effect],
     ) as handler:
-        while it < max_iter:
-            batches = (
-                sampler.next_window()
-                if sampler
-                else _synthetic_batches(solver.net, args.tau)
-            )
-            if trainer is not None:
-                state, _ = trainer.step(state, batches)
-            else:
-                state, _ = solver.step(state, batches)
-            it += args.tau
-            # throttled logging (SolverParameter.display semantics,
-            # solver.cpp:237): reading smoothed_loss is the device sync
-            # point, so it runs once per display interval, not per window
-            disp = solver_param.display or args.tau
-            if it % disp < args.tau:
-                log.log(f"iter {it} smoothed_loss {solver.smoothed_loss:.4f}")
-            action = handler.get_action()
-            if action == SolverAction.SNAPSHOT or (
-                snap_every and it % snap_every < args.tau and it >= snap_every
-            ):
-                if ckpt is not None:
-                    ckpt.save(solver, state, prefix)
-                    log.log(f"async snapshot started at iter {it}")
+        try:
+            while it < max_iter:
+                batches = feed.next_round(r)
+                r += 1
+                if trainer is not None:
+                    state, _ = trainer.step(state, batches)
                 else:
-                    paths = checkpoint.snapshot(solver, state, prefix)
-                    log.log(f"snapshotted to {paths[0]}")
-            if action == SolverAction.STOP:
-                log.log("stop requested; snapshotting and exiting")
-                if ckpt is not None:
-                    ckpt.save(solver, state, prefix)
-                else:
-                    checkpoint.snapshot(solver, state, prefix)
-                break
+                    state, _ = solver.step(state, batches)
+                it += args.tau
+                # throttled logging (SolverParameter.display semantics,
+                # solver.cpp:237): reading smoothed_loss is the device
+                # sync point, so it runs once per display interval, not
+                # per window
+                disp = solver_param.display or args.tau
+                if it % disp < args.tau:
+                    log.log(
+                        f"iter {it} smoothed_loss {solver.smoothed_loss:.4f}"
+                    )
+                action = handler.get_action()
+                if action == SolverAction.SNAPSHOT or (
+                    snap_every
+                    and it % snap_every < args.tau
+                    and it >= snap_every
+                ):
+                    if ckpt is not None:
+                        ckpt.save(solver, state, prefix)
+                        log.log(f"async snapshot started at iter {it}")
+                    else:
+                        paths = checkpoint.snapshot(solver, state, prefix)
+                        log.log(f"snapshotted to {paths[0]}")
+                if action == SolverAction.STOP:
+                    log.log("stop requested; snapshotting and exiting")
+                    if ckpt is not None:
+                        ckpt.save(solver, state, prefix)
+                    else:
+                        checkpoint.snapshot(solver, state, prefix)
+                    break
+        finally:
+            # a step/snapshot exception must not leak the producer
+            # thread (and its in-flight device batches)
+            feed.stop()
         if ckpt is not None:
             paths = ckpt.wait()
             if paths:
@@ -749,6 +775,10 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--async_snapshot", action="store_true",
                    help="write snapshots on a background thread")
+    p.add_argument("--serial_feed", action="store_true",
+                   help="disable the pipelined round feed (assemble+H2D "
+                   "on the training loop) — for relay-degraded links "
+                   "where overlapped transfers collapse (PERF.md)")
     p.add_argument("--devices", type=int, default=1,
                    help="N>1: synchronous allreduce DP over the first N "
                    "local devices (the caffe train --gpu=0,..,N-1 analog; "

@@ -103,6 +103,73 @@ def test_chaos_mode_smoke():
     assert rec["loss_band_ok"] is True
 
 
+def test_unknown_mode_rejected():
+    """--mode typos must die immediately (before any backend import or
+    jax work), never fall through to the chip-touching train default."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py"), "--mode=bogus"],
+        capture_output=True, text=True, timeout=60,
+        env={**os.environ, "PYTHONPATH": _REPO},
+    )
+    assert out.returncode != 0
+    assert "unknown mode 'bogus'" in out.stderr
+    assert "pipeline" in out.stderr  # the error lists the valid modes
+    # env-var route rejects identically
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py")],
+        capture_output=True, text=True, timeout=60,
+        env={**os.environ, "PYTHONPATH": _REPO, "BENCH_MODE": "nope"},
+    )
+    assert out.returncode != 0 and "unknown mode 'nope'" in out.stderr
+
+
+@pytest.mark.slow
+def test_pipeline_mode_smoke():
+    """bench.py --mode=pipeline end to end in a subprocess: one JSON
+    line, pipelined < serial on the synthetic A/B."""
+    rec = _run_bench({
+        "BENCH_MODE": "pipeline", "BENCH_ROUNDS": "3",
+        "BENCH_ASSEMBLY_MS": "400",
+    })
+    assert rec["metric"] == "pipeline_overlap_speedup"
+    assert rec["value"] > 1.0
+    assert rec["pipelined_round_ms"] < rec["serial_round_ms"]
+    assert rec["real"]["serial_round_ms"] > 0
+
+
+_PIPELINE_SCHEMA_KEYS = (
+    "metric", "value", "unit", "vs_baseline", "platform", "workers",
+    "tau", "batch", "rounds", "step_ms", "assembly_ms",
+    "serial_round_ms", "pipelined_round_ms", "ideal_round_ms",
+    "overlap_efficiency", "real",
+)
+
+
+def test_committed_pipeline_artifact_schema():
+    """PIPELINE_r08.json — the pipelined-round-feed committed artifact:
+    the synthetic A/B must show the pipelined loop strictly faster than
+    the serial loop (the ISSUE 3 done-bar), with the overlap-efficiency
+    decomposition internally consistent."""
+    with open(os.path.join(_REPO, "PIPELINE_r08.json")) as f:
+        d = json.load(f)
+    for key in _PIPELINE_SCHEMA_KEYS:
+        assert key in d, key
+    assert d["metric"] == "pipeline_overlap_speedup"
+    assert d["value"] == d["vs_baseline"] > 1.0
+    assert d["pipelined_round_ms"] < d["serial_round_ms"]
+    # the decomposition: serial ~ assembly + step, ideal = max of the two
+    assert d["ideal_round_ms"] == max(d["assembly_ms"], d["step_ms"])
+    assert d["serial_round_ms"] > d["ideal_round_ms"]
+    # pipelined sits at (or noise-near) the ideal: the assembly is hidden
+    assert d["overlap_efficiency"] is not None
+    assert d["overlap_efficiency"] > 0.5, d["overlap_efficiency"]
+    # the real cifar10_quick leg rides along with the same shape
+    for key in ("assembly_ms", "serial_round_ms", "pipelined_round_ms",
+                "speedup", "overlap_efficiency"):
+        assert key in d["real"], key
+    assert d["workers"] >= 2 and d["rounds"] >= 1
+
+
 _CHAOS_SCHEMA_KEYS = (
     "metric", "value", "unit", "vs_baseline", "faults_injected",
     "faults_survived", "faults", "recovery_latency_s", "resumed_from_iter",

@@ -86,11 +86,15 @@ def test_storage_fault_hook_slots_never_bleed_into_one_fetch():
 
 
 def test_feed_delivers_rounds_in_order_across_watchdog_rebuild():
-    """The feed's round cursor is per-prefetcher-generation: after a
-    stall fires the watchdog and the prefetcher is rebuilt, every round
-    still arrives exactly once, in order, with the right contents (a
-    stale producer thread can never skip a round)."""
+    """The feed's round cursor is per-producer-generation (RoundFeed):
+    after a stall fires the watchdog and the feed is restarted, every
+    round still arrives exactly once, in order, with the right contents
+    (a stale producer thread can never skip a round) — now as the
+    dp-placed device batch the training round consumes directly."""
+    import jax
     import numpy as np
+
+    from sparknet_tpu.parallel import make_mesh
 
     plan = dataclasses.replace(
         chaos.FaultPlan.default(),
@@ -106,14 +110,18 @@ def test_feed_delivers_rounds_in_order_across_watchdog_rebuild():
         "storage_injected": 0, "storage_survived": 0,
         "stalls_injected": 0, "stalls_survived": 0,
     }
-    feed = chaos._Feed(plan, xs, ys, counters, [])
+    mesh = make_mesh(
+        {"dp": plan.workers}, devices=jax.devices()[: plan.workers]
+    )
+    feed = chaos._Feed(plan, xs, ys, counters, [], mesh)
     try:
         for r in range(plan.rounds):
             b = feed.next_round(r)
+            data = np.asarray(b["data"])  # placed over dp by the feed
             for w in range(plan.workers):
                 for t in range(plan.tau):
                     i = (r * plan.workers * plan.tau + w * plan.tau + t) % 8
-                    assert float(b["data"][w, t, 0, 0, 0, 0]) == float(i), (
+                    assert float(data[w, t, 0, 0, 0, 0]) == float(i), (
                         r, w, t,
                     )
     finally:

@@ -120,6 +120,26 @@ def test_prefetcher_stall_watchdog_raises():
     pf.stop(timeout=6.0)
 
 
+def test_prefetcher_stopped_raises_stopiteration_immediately():
+    """Regression: __next__ on a stop()ed prefetcher with a stall
+    watchdog armed used to wait out the whole stall_timeout_s and then
+    raise a misleading PrefetchStall on the deliberately-drained queue.
+    Once stopped, iteration is over NOW: StopIteration, immediately."""
+    def produce():
+        time.sleep(0.02)
+        return {"x": np.zeros(1, np.float32)}
+
+    pf = Prefetcher(produce, depth=1, device_put=False,
+                    stall_timeout_s=5.0)
+    next(pf)
+    assert pf.stop() is True
+    t0 = time.monotonic()
+    with pytest.raises(StopIteration):
+        next(pf)
+    # did NOT wait out the 5s watchdog window
+    assert time.monotonic() - t0 < 1.0
+
+
 def test_prefetcher_no_watchdog_by_default():
     """stall_timeout_s=None keeps the original blocking behavior (no
     spurious stalls on slow-but-healthy producers)."""

@@ -1,0 +1,288 @@
+"""RoundFeed (``data/round_feed.py``): the pipelined round executor.
+
+Unit level: ordering/termination contract, buffer recycling, the
+stall -> restart recovery pattern, the serial fallback, and the
+CPU-aliasing recycle gate.  Integration level: the determinism contract
+— a pipelined cifar10_quick run must produce a TrainState that is
+BIT-IDENTICAL to the serial loop's (the framework's contract; this is
+the ISSUE 3 acceptance test)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+import jax
+
+from sparknet_tpu.data.round_feed import (
+    PrefetchStall,
+    RoundFeed,
+    sharded_put_may_alias,
+    stack_windows,
+)
+
+# ----------------------------------------------------------------------
+# unit: the executor contract (no jax net involved; place=identity)
+
+
+def _counting_assemble(log, n_blobs=1):
+    """assemble() that records (round, reused_buffer) and returns a
+    fresh dict whose contents encode the round index."""
+
+    def assemble(r, out):
+        log.append((r, out is not None))
+        return {f"b{i}": np.full((2, 3), r, np.float32)
+                for i in range(n_blobs)}
+
+    return assemble
+
+
+def test_rounds_deliver_in_order_and_end_after_num_rounds():
+    log = []
+    feed = RoundFeed(
+        _counting_assemble(log), place=lambda h: h, pipelined=True,
+        num_rounds=4, recycle=False,
+    )
+    try:
+        for r in range(4):
+            out = feed.next_round(r)
+            assert float(out["b0"][0, 0]) == float(r)
+        with pytest.raises(StopIteration):
+            feed.next_round(4)
+    finally:
+        feed.stop()
+    # assemble ran exactly once per round, in round order
+    assert [r for r, _ in log] == [0, 1, 2, 3]
+
+
+def test_out_of_order_request_raises():
+    feed = RoundFeed(
+        _counting_assemble([]), place=lambda h: h, num_rounds=4,
+        recycle=False,
+    )
+    try:
+        feed.next_round(0)
+        with pytest.raises(ValueError, match="consumed in order"):
+            feed.next_round(2)
+    finally:
+        feed.stop()
+
+
+def test_serial_fallback_same_values_no_producer_thread():
+    log = []
+    feed = RoundFeed(
+        _counting_assemble(log), place=lambda h: h, pipelined=False,
+        num_rounds=3, recycle=False,
+    )
+    assert feed._pf is None  # no producer thread in serial mode
+    for r in range(3):
+        out = feed.next_round(r)
+        assert float(out["b0"][0, 0]) == float(r)
+    assert [r for r, _ in log] == [0, 1, 2]
+    assert feed.stop() is True  # no-op, reports success
+
+
+def test_recycle_hands_the_same_buffer_back():
+    """With recycle forced on and a COPYING place, assemble sees its own
+    previous output dict back from round 1 on (the preallocated-buffer
+    contract) and every delivered batch still carries its round's
+    values."""
+    seen = []
+
+    def assemble(r, out):
+        seen.append(out)
+        windows = [
+            {"x": np.full((3,), 10 * r + w, np.float32)} for w in range(2)
+        ]
+        return stack_windows(windows, out)
+
+    feed = RoundFeed(
+        assemble,
+        place=lambda h: {k: v.copy() for k, v in h.items()},  # no alias
+        pipelined=True, num_rounds=3, recycle=True,
+    )
+    try:
+        outs = [feed.next_round(r) for r in range(3)]
+    finally:
+        feed.stop()
+    assert seen[0] is None  # first round allocates
+    assert seen[1] is not None and seen[2] is seen[1]  # then recycled
+    for r, out in enumerate(outs):
+        np.testing.assert_array_equal(
+            out["x"], np.array([[10 * r] * 3, [10 * r + 1] * 3], np.float32)
+        )
+
+
+def test_cpu_auto_gate_disables_recycling():
+    """On the cpu backend a sharded device_put zero-copies (the device
+    shards alias the numpy buffer), so the auto mode must NOT recycle —
+    assemble gets out=None every round."""
+    assert sharded_put_may_alias() is True  # this suite runs on cpu
+    log = []
+    feed = RoundFeed(
+        _counting_assemble(log), place=lambda h: h, num_rounds=3
+    )
+    try:
+        for r in range(3):
+            feed.next_round(r)
+    finally:
+        feed.stop()
+    assert all(reused is False for _, reused in log)
+
+
+def test_stall_raises_and_restart_recovers():
+    """A producer wedged past stall_timeout_s surfaces PrefetchStall on
+    the consumer; restart(r) reaps the generation and redelivers round r
+    (the chaos-harness recovery pattern)."""
+    stall_once = threading.Event()
+
+    def assemble(r, out):
+        if r == 1 and not stall_once.is_set():
+            stall_once.set()
+            time.sleep(1.0)
+        return {"x": np.full((2,), r, np.float32)}
+
+    feed = RoundFeed(
+        assemble, place=lambda h: h, num_rounds=3, depth=1,
+        stall_timeout_s=0.2, recycle=False,
+    )
+    try:
+        assert float(feed.next_round(0)["x"][0]) == 0.0
+        with pytest.raises(PrefetchStall):
+            feed.next_round(1)
+        feed.restart(1)
+        assert float(feed.next_round(1)["x"][0]) == 1.0
+        assert float(feed.next_round(2)["x"][0]) == 2.0
+    finally:
+        feed.stop()
+
+
+def test_assemble_error_propagates():
+    def assemble(r, out):
+        if r == 1:
+            raise RuntimeError("boom in assembly")
+        return {"x": np.zeros(1, np.float32)}
+
+    feed = RoundFeed(assemble, place=lambda h: h, num_rounds=3,
+                     recycle=False)
+    try:
+        feed.next_round(0)
+        with pytest.raises(RuntimeError, match="boom in assembly"):
+            feed.next_round(1)
+    finally:
+        feed.stop()
+
+
+def test_stack_windows_out_matches_allocating_path():
+    rng = np.random.RandomState(0)
+    windows = [
+        {"data": rng.randn(2, 4).astype(np.float32),
+         "label": rng.randn(2).astype(np.float32)}
+        for _ in range(3)
+    ]
+    fresh = stack_windows(windows)
+    out = {k: np.empty_like(v) for k, v in fresh.items()}
+    refilled = stack_windows(windows, out)
+    assert refilled is out
+    for k in fresh:
+        np.testing.assert_array_equal(fresh[k], out[k])
+
+
+def test_mesh_sharding_is_cached_and_applied():
+    """mesh= places the batch over the dp axis with the cached
+    NamedSharding (built once, not per round)."""
+    from sparknet_tpu.parallel import leading_sharding, make_mesh
+
+    mesh = make_mesh({"dp": 2}, devices=jax.devices()[:2])
+    feed = RoundFeed(
+        lambda r, out: {"x": np.full((2, 3), r, np.float32)},
+        mesh=mesh, num_rounds=2,
+    )
+    try:
+        out = feed.next_round(0)
+        assert out["x"].sharding == leading_sharding(mesh, "dp")
+        assert feed._sharding is leading_sharding(mesh, "dp")  # cached
+    finally:
+        feed.stop()
+
+
+# ----------------------------------------------------------------------
+# integration: bit-identity with the serial loop (ISSUE 3 acceptance)
+
+
+def test_pipelined_round_loop_bit_identical_to_serial():
+    """Two cifar10_quick ParameterAveragingTrainer runs over the same
+    deterministic per-round windows — one via the serial
+    assemble->place->round loop, one via the pipelined RoundFeed — must
+    land on EXACTLY the same TrainState (params, stats, history, iter)
+    and losses: determinism is the framework's contract and the
+    pipelined feed changes numerics by exactly nothing."""
+    from sparknet_tpu import config as cfg, models
+    from sparknet_tpu.data import CifarLoader
+    from sparknet_tpu.parallel import (
+        ParameterAveragingTrainer,
+        make_mesh,
+        shard_leading,
+    )
+    from sparknet_tpu.solver import Solver
+
+    workers, tau, batch, rounds = 2, 2, 8, 3
+    import tempfile
+
+    data_dir = tempfile.mkdtemp(prefix="rf_bitid_")
+    CifarLoader.write_synthetic(data_dir, num_train=64, num_test=8, seed=5)
+    xs, ys = CifarLoader(data_dir).minibatches(batch, train=True)
+
+    def window(r):
+        """Deterministic worker-stacked window for round r."""
+        n = len(xs)
+        data = np.empty((workers, tau) + xs[0].shape, np.float32)
+        label = np.empty((workers, tau, batch), np.float32)
+        for w in range(workers):
+            for t in range(tau):
+                i = (r * workers * tau + w * tau + t) % n
+                data[w, t] = xs[i]
+                label[w, t] = ys[i]
+        return {"data": data, "label": label}
+
+    def build():
+        netp = cfg.replace_data_layers(
+            models.load_model("cifar10_quick"),
+            [(batch, 3, 32, 32), (batch,)],
+            [(batch, 3, 32, 32), (batch,)],
+        )
+        solver = Solver(
+            models.load_model_solver("cifar10_quick"), net_param=netp
+        )
+        mesh = make_mesh({"dp": workers}, devices=jax.devices()[:workers])
+        return solver, mesh, ParameterAveragingTrainer(solver, mesh)
+
+    # serial reference loop (the pre-RoundFeed app loop, verbatim)
+    solver_a, mesh_a, tr_a = build()
+    st_a = tr_a.init_state(seed=0)
+    losses_a = None
+    for r in range(rounds):
+        st_a, losses_a = tr_a.round(st_a, shard_leading(window(r), mesh_a))
+
+    # pipelined loop
+    solver_b, mesh_b, tr_b = build()
+    st_b = tr_b.init_state(seed=0)
+    losses_b = None
+    feed = RoundFeed(
+        lambda r, out: window(r), mesh=mesh_b, num_rounds=rounds
+    )
+    try:
+        for r in range(rounds):
+            st_b, losses_b = tr_b.round(st_b, feed.next_round(r))
+    finally:
+        feed.stop()
+
+    np.testing.assert_array_equal(
+        np.asarray(losses_a), np.asarray(losses_b)
+    )
+    flat_a, tree_a = jax.tree_util.tree_flatten(jax.device_get(st_a))
+    flat_b, tree_b = jax.tree_util.tree_flatten(jax.device_get(st_b))
+    assert tree_a == tree_b
+    assert flat_a, "empty state?"
+    for la, lb in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))

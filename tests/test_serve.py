@@ -274,6 +274,34 @@ def test_histogram_quantiles_reservoir():
     assert h.quantile(0.95) == pytest.approx(0.96)
 
 
+def test_histogram_quantile_sorts_once_per_scrape():
+    """A scrape reading p50/p95/p99 must sort the reservoir ONCE (the
+    cached sorted view is shared across consecutive quantile reads) and
+    the next observation must invalidate it — with values consistent
+    with a fresh nearest-rank computation throughout."""
+    h = Histogram("h", reservoir=64)
+    rng = __import__("random").Random(3)
+    vals = [rng.random() for _ in range(64)]
+    for v in vals:
+        h.observe(v)
+    p50, p95, p99 = h.quantile(0.50), h.quantile(0.95), h.quantile(0.99)
+    # all three reads shared one sorted view (same list object)
+    assert h._sorted is not None
+    first_view = h._sorted
+    assert h.quantile(0.95) == p95 and h._sorted is first_view
+    # ordered and consistent with an independent nearest-rank compute
+    ref = sorted(vals)
+    assert p50 <= p95 <= p99
+    assert p50 == ref[min(63, int(0.50 * 64))]
+    assert p95 == ref[min(63, int(0.95 * 64))]
+    assert p99 == ref[min(63, int(0.99 * 64))]
+    # an observation invalidates the cache; the next read re-sorts
+    h.observe(123.0)
+    assert h._sorted is None
+    assert h.quantile(0.99) == 123.0  # overwrote the oldest; new max
+    assert h._sorted is not first_view
+
+
 def test_registry_renders_prometheus_text():
     reg = MetricsRegistry()
     c = reg.counter("requests_total", "total requests")
