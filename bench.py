@@ -45,6 +45,16 @@ Modes (env):
                         efficiency against the ideal max(assembly, step)
                         (PIPELINE_r08.json artifact)
 
+  BENCH_MODE=obs        telemetry-overhead A/B (sparknet_tpu/obs): the
+                        same pipelined cifar10_quick round loop timed
+                        with observability fully off, with the metrics
+                        registry on, and with round-span tracing on
+                        (Chrome trace + JSONL written); reports the
+                        per-leg round times, the traced-run overhead in
+                        % (<2% acceptance), the measured cost of a
+                        disabled span, and the span/overlap audit of
+                        the produced trace (OBS_r09.json artifact)
+
 Modes can also be selected as ``python bench.py --mode=serve`` (flag
 wins over the env var); an unknown mode is rejected.
   BENCH_PROFILE=1       also print the `caffe time`-style per-layer table
@@ -64,7 +74,7 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
-_MODES = ("train", "hostfeed", "scaling", "serve", "chaos", "pipeline")
+_MODES = ("train", "hostfeed", "scaling", "serve", "chaos", "pipeline", "obs")
 _MODE = os.environ.get("BENCH_MODE", "train")
 for _i, _a in enumerate(sys.argv[1:], start=1):
     if _a.startswith("--mode="):
@@ -81,7 +91,7 @@ if _MODE not in _MODES:
         "bench.py: unknown mode %r (expected one of %s)"
         % (_MODE, "|".join(_MODES))
     )
-if _MODE in ("scaling", "chaos", "pipeline"):
+if _MODE in ("scaling", "chaos", "pipeline", "obs"):
     # these modes need >1 device; on a 1-chip host force the virtual CPU
     # mesh (the driver's multichip validation environment).  This must run
     # BEFORE the first backend use (XLA_FLAGS is parsed once per process),
@@ -1047,6 +1057,200 @@ def bench_pipeline():
     print(json.dumps(out))
 
 
+def bench_obs():
+    """Telemetry-overhead A/B (``sparknet_tpu/obs``).
+
+    Times the SAME pipelined round loop the apps run (cifar10_quick on
+    the virtual dp mesh, RoundFeed producer + per-round sync) in three
+    regimes, in order: (1) observability fully off — spans are the
+    shared no-op, (2) the metrics registry enabled — spans feed the
+    per-phase histogram, (3) round-span tracing on — Chrome trace +
+    JSONL run log actually written.  Each regime is warmed and
+    best-of-``BENCH_PASSES``; the headline is the traced-run overhead
+    in percent (acceptance: < 2%).  The disabled-span cost is also
+    measured directly (ns/span microbenchmark) so "~0 when off" is a
+    number, not a claim.  The produced trace is audited: spans for
+    assemble/h2d/execute/average must exist, the producer thread must
+    be distinct from the consumer, and at least one producer assemble
+    must overlap a consumer execute in time — the same checks
+    ``tools/trace_report.py`` makes human-readable."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from sparknet_tpu import config as cfg, models, obs
+    from sparknet_tpu.data import CifarLoader, RoundFeed
+    from sparknet_tpu.parallel import ParameterAveragingTrainer, make_mesh
+    from sparknet_tpu.solver import Solver
+
+    workers = int(os.environ.get("BENCH_WORKERS", "2"))
+    tau = int(os.environ.get("BENCH_TAU", "2"))
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    rounds = int(os.environ.get("BENCH_ROUNDS", "5"))
+    passes = max(1, int(os.environ.get("BENCH_PASSES", "3")))
+
+    workdir = tempfile.mkdtemp(prefix="bench_obs_")
+    data_dir = os.path.join(workdir, "data")
+    CifarLoader.write_synthetic(data_dir, num_train=256, num_test=32, seed=9)
+    xs, ys = CifarLoader(data_dir).minibatches(batch, train=True)
+
+    def window(r):
+        n = len(xs)
+        data = np.empty((workers, tau) + xs[0].shape, np.float32)
+        label = np.empty((workers, tau, batch), np.float32)
+        for w in range(workers):
+            for t in range(tau):
+                i = (r * workers * tau + w * tau + t) % n
+                data[w, t] = xs[i]
+                label[w, t] = ys[i]
+        return {"data": data, "label": label}
+
+    netp = cfg.replace_data_layers(
+        models.load_model("cifar10_quick"),
+        [(batch, 3, 32, 32), (batch,)],
+        [(batch, 3, 32, 32), (batch,)],
+    )
+    solver = Solver(models.load_model_solver("cifar10_quick"), net_param=netp)
+    mesh = make_mesh({"dp": workers}, devices=jax.devices()[:workers])
+    trainer = ParameterAveragingTrainer(solver, mesh)
+
+    # a small real assembly cost (host-I/O stand-in, identical in all
+    # three legs so the A/B stays fair): far below the ~1s step, fully
+    # hidden by the pipeline, and it guarantees the producer's assemble
+    # spans genuinely overlap consumer execute spans in the trace audit
+    assembly_s = float(os.environ.get("BENCH_OBS_ASSEMBLY_MS", "25")) / 1e3
+
+    def assemble(r, out):
+        time.sleep(assembly_s)
+        return window(r)
+
+    def timed_loop():
+        """Mean round seconds of the apps' pipelined loop (RoundFeed
+        producer assembly+H2D under the round, per-round sync)."""
+        feed = RoundFeed(assemble, mesh=mesh, num_rounds=rounds + 1)
+        try:
+            state = trainer.init_state(seed=0)
+            state, losses = trainer.round(state, feed.next_round(0))
+            jax.block_until_ready(losses)  # compile + warm off the clock
+            t0 = time.perf_counter()
+            for r in range(1, rounds + 1):
+                state, losses = trainer.round(state, feed.next_round(r))
+                jax.block_until_ready(losses)
+            return (time.perf_counter() - t0) / rounds
+        finally:
+            feed.stop()
+
+    def best_of(n):
+        timed_loop()  # per-leg steady-state entry (drift control)
+        return min(timed_loop() for _ in range(n))
+
+    # ---- leg 0 (before anything is enabled): the disabled-span cost
+    assert obs.get_tracer() is None and obs.training_metrics() is None
+    n_spans = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n_spans):
+        with obs.span("x"):
+            pass
+    off_span_ns = (time.perf_counter() - t0) / n_spans * 1e9
+
+    # ---- leg 1: observability fully off
+    timed_loop()  # whole-path warmup (cold-start variance on this box)
+    base_s = best_of(passes)
+
+    # ---- leg 2: metrics registry on (spans -> per-phase histogram)
+    obs.enable_training_metrics()
+    metrics_s = best_of(passes)
+
+    # ---- leg 3: tracing on (Chrome trace + JSONL actually written)
+    trace_path = os.path.join(workdir, "bench_obs.trace.json")
+    run = obs.start(trace_out=trace_path, echo=None)
+    traced_s = best_of(passes)
+    run.close()
+
+    overhead_metrics_pct = (metrics_s - base_s) / base_s * 100.0
+    overhead_traced_pct = (traced_s - base_s) / base_s * 100.0
+    off_span_overhead_pct = (
+        # 4 phase spans per round (assemble/h2d on the producer,
+        # average/execute on the consumer) at the measured no-op cost
+        4 * off_span_ns / 1e9 / base_s * 100.0
+    )
+
+    # ---- audit the produced trace with the SAME fold tools/
+    # trace_report.py renders (one implementation of the grouping +
+    # overlap rule, not a bench-local copy)
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_trace_report", os.path.join(_REPO, "tools", "trace_report.py")
+    )
+    trace_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(trace_report)
+    rep = trace_report.fold(trace_report.load_events(trace_path))
+    span_counts = {k: v["count"] for k, v in rep["phases"].items()}
+    exec_thr = set(rep["phases"].get("execute", {}).get("threads", ()))
+    asm_thr = set(rep["phases"].get("assemble", {}).get("threads", ()))
+    producer_thread_distinct = bool(
+        asm_thr and exec_thr and not (asm_thr & exec_thr)
+    )
+    overlap = rep["producer_overlap_observed"]
+    jsonl_path = obs.jsonl_path_for(trace_path)
+    with open(jsonl_path) as f:
+        jsonl_lines = sum(1 for line in f if json.loads(line))
+
+    print(
+        "obs: round %.1f ms off | %.1f ms metrics (%+.2f%%) | %.1f ms "
+        "traced (%+.2f%%) | disabled span %.0f ns (~%.4f%%/round) | "
+        "spans %s | producer distinct %s, overlap %s | %d JSONL lines"
+        % (
+            base_s * 1e3, metrics_s * 1e3, overhead_metrics_pct,
+            traced_s * 1e3, overhead_traced_pct, off_span_ns,
+            off_span_overhead_pct, span_counts, producer_thread_distinct,
+            overlap, jsonl_lines,
+        ),
+        file=sys.stderr,
+    )
+    out = {
+        "metric": "obs_tracing_overhead_pct",
+        "value": round(overhead_traced_pct, 3),
+        "unit": "% of uninstrumented round time",
+        # done-bar: <= 1.0, i.e. inside the 2% acceptance budget
+        "vs_baseline": round(overhead_traced_pct / 2.0, 3),
+        "platform": jax.devices()[0].platform,
+        "workers": workers,
+        "tau": tau,
+        "batch": batch,
+        "rounds": rounds,
+        "passes": passes,
+        "baseline_round_ms": round(base_s * 1e3, 2),
+        "metrics_round_ms": round(metrics_s * 1e3, 2),
+        "traced_round_ms": round(traced_s * 1e3, 2),
+        "overhead_metrics_pct": round(overhead_metrics_pct, 3),
+        "overhead_traced_pct": round(overhead_traced_pct, 3),
+        "off_span_ns": round(off_span_ns, 1),
+        "off_span_overhead_pct": round(off_span_overhead_pct, 6),
+        "span_counts": span_counts,
+        "producer_thread_distinct": producer_thread_distinct,
+        "producer_overlap_observed": overlap,
+        "jsonl_lines": jsonl_lines,
+        "note": "three timed regimes of the apps' pipelined cifar10_quick "
+        "round loop, each warmed and best-of-N: obs off / metrics "
+        "registry on / tracing on (Chrome trace + JSONL written). "
+        "value is the traced-run round-time overhead vs the off leg "
+        "(<2% acceptance). Honest noise disclosure: on this shared "
+        "2-core box run-to-run drift is +/-1-3% of a ~0.9s round, while "
+        "the true per-round instrumentation cost is ~8 span "
+        "start/stops (microseconds) — the A/B bounds the overhead "
+        "under noise, and off_span_ns is the CONTROLLED measurement "
+        "of the disabled-path span (the '~0 when off' claim, as a "
+        "number; x4 phase spans/round = off_span_overhead_pct). "
+        "span_counts/overlap audit the trace itself: producer-thread "
+        "assemble/h2d spans must interleave with consumer execute "
+        "spans — the same folding tools/trace_report.py renders",
+    }
+    print(json.dumps(out))
+
+
 def main():
     if _MODE == "scaling":
         bench_scaling()
@@ -1062,6 +1266,9 @@ def main():
         return
     if _MODE == "pipeline":
         bench_pipeline()
+        return
+    if _MODE == "obs":
+        bench_obs()
         return
     # the remote-TPU tunnel occasionally drops a request mid-run; one
     # retry keeps the recorded benchmark from dying on a transient

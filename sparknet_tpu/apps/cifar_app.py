@@ -39,6 +39,9 @@ def main(argv=None) -> int:
         "training loop) — for relay-degraded links where overlapped "
         "transfers collapse throughput (PERF.md)",
     )
+    from sparknet_tpu import obs
+
+    obs.add_cli_args(parser)  # --obs / --obs_port / --trace_out
     args = parser.parse_args(argv)
 
     import jax
@@ -140,6 +143,7 @@ def main(argv=None) -> int:
     # recycled buffers and device_put on a producer thread while round r
     # executes (RoundFeed; --serial_feed restores the old serial path
     # with identical numerics)
+    run_obs = obs.start_from_args(args, echo=log.log)
     feed = RoundFeed(
         lambda r, out: stack_windows(
             [s.next_window() for s in samplers], out
@@ -156,11 +160,12 @@ def main(argv=None) -> int:
             log.log(
                 f"round {r} trained, smoothed_loss {solver.smoothed_loss:.4f}"
             )
+        log.log(f"final accuracy {evaluate():.4f}")
+        return 0
     finally:
         feed.stop()
-
-    log.log(f"final accuracy {evaluate():.4f}")
-    return 0
+        run_obs.close()
+        log.close()
 
 
 if __name__ == "__main__":

@@ -58,6 +58,9 @@ def main(argv=None) -> int:
         help="disable the pipelined round feed (PERF.md: relay-degraded "
         "links)",
     )
+    from sparknet_tpu import obs
+
+    obs.add_cli_args(parser)  # --obs / --obs_port / --trace_out
     args = parser.parse_args(argv)
 
     import jax
@@ -125,6 +128,7 @@ def main(argv=None) -> int:
             )
         return stack_windows(windows, out)
 
+    run_obs = obs.start_from_args(args, echo=log.log)
     feed = RoundFeed(
         assemble,
         mesh=mesh,
@@ -137,27 +141,33 @@ def main(argv=None) -> int:
             log.log(
                 f"round {r} trained, smoothed_loss {solver.smoothed_loss:.4f}"
             )
-    finally:
-        feed.stop()
 
-    # eval from the test DB
-    nb = 2
-    tb = [test_pipe.next() for _ in range(args.workers * nb)]
-    test_batches = {
-        "data": np.stack([b[0] for b in tb]).reshape(
-            args.workers, nb, args.batch, 3, 32, 32
-        ),
-        "label": np.stack([b[1] for b in tb]).reshape(args.workers, nb, args.batch),
-    }
-    scores = trainer.test_and_store_result(
-        state, shard_leading(test_batches, mesh)
-    )
-    acc = scores.get("accuracy", 0.0) / (args.workers * nb)
-    log.log(f"final accuracy {acc:.4f}")
-    for p in pipes:
-        p.close()
-    test_pipe.close()
-    return 0
+        # eval from the test DB
+        nb = 2
+        tb = [test_pipe.next() for _ in range(args.workers * nb)]
+        test_batches = {
+            "data": np.stack([b[0] for b in tb]).reshape(
+                args.workers, nb, args.batch, 3, 32, 32
+            ),
+            "label": np.stack([b[1] for b in tb]).reshape(
+                args.workers, nb, args.batch
+            ),
+        }
+        scores = trainer.test_and_store_result(
+            state, shard_leading(test_batches, mesh)
+        )
+        acc = scores.get("accuracy", 0.0) / (args.workers * nb)
+        log.log(f"final accuracy {acc:.4f}")
+        return 0
+    finally:
+        # telemetry closes AFTER the final-accuracy line so the JSONL
+        # run log carries the run's headline result too
+        feed.stop()
+        run_obs.close()
+        log.close()
+        for p in pipes:
+            p.close()
+        test_pipe.close()
 
 
 if __name__ == "__main__":

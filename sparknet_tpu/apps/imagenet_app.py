@@ -84,6 +84,9 @@ def main(argv=None) -> int:
         help="disable the pipelined round feed (assemble+H2D on the "
         "training loop) — for relay-degraded links (PERF.md)",
     )
+    from sparknet_tpu import obs
+
+    obs.add_cli_args(parser)  # --obs / --obs_port / --trace_out
     args = parser.parse_args(argv)
 
     import jax
@@ -301,6 +304,7 @@ def main(argv=None) -> int:
     # pipelined round feed: the uint8 windows for round r+1 are stacked
     # into recycled buffers and device_put on a producer thread while
     # round r executes (--serial_feed restores the serial path)
+    run_obs = obs.start_from_args(args, echo=log.log)
     feed = RoundFeed(
         lambda r, out: stack_windows(
             [s.next_window() for s in samplers], out
@@ -318,14 +322,17 @@ def main(argv=None) -> int:
             log.log(
                 f"trained, smoothed_loss {solver.smoothed_loss:.4f}", i=r
             )
+        acc = evaluate()
+        log.log(f"final accuracy {acc * 100:.2f}%")
+        if jax.process_index() == 0:
+            print(f"final accuracy {acc * 100:.2f}%")
+        return 0
     finally:
+        # telemetry closes AFTER the final-accuracy line so the JSONL
+        # run log carries the run's headline result too
         feed.stop()
-
-    acc = evaluate()
-    log.log(f"final accuracy {acc * 100:.2f}%")
-    if jax.process_index() == 0:
-        print(f"final accuracy {acc * 100:.2f}%")
-    return 0
+        run_obs.close()
+        log.close()
 
 
 if __name__ == "__main__":

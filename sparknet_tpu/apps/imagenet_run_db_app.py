@@ -65,6 +65,9 @@ def main(argv=None) -> int:
         help="disable the pipelined round feed (PERF.md: relay-degraded "
         "links)",
     )
+    from sparknet_tpu import obs
+
+    obs.add_cli_args(parser)  # --obs / --obs_port / --trace_out
     args = parser.parse_args(argv)
 
     import jax
@@ -206,6 +209,7 @@ def main(argv=None) -> int:
     # pipelined feed, resume-aware: rounds are absolute, so a resumed
     # run's producer starts at start_round and the reader pipelines pick
     # up where the DB cursors sit (--serial_feed: old serial path)
+    run_obs = obs.start_from_args(args, echo=log.log)
     feed = RoundFeed(
         assemble,
         mesh=mesh,
@@ -226,15 +230,19 @@ def main(argv=None) -> int:
                     solver, st, prefix
                 )
                 log.log(f"snapshot -> {model_path}", i=r)
-    finally:
-        feed.stop()
 
-    acc = evaluate()
-    log.log(f"final accuracy {acc * 100:.2f}%")
-    print(f"final accuracy {acc * 100:.2f}%")
-    for p in pipes + test_pipes:
-        p.close()
-    return 0
+        acc = evaluate()
+        log.log(f"final accuracy {acc * 100:.2f}%")
+        print(f"final accuracy {acc * 100:.2f}%")
+        return 0
+    finally:
+        # telemetry closes AFTER the final-accuracy line so the JSONL
+        # run log carries the run's headline result too
+        feed.stop()
+        run_obs.close()
+        log.close()
+        for p in pipes + test_pipes:
+            p.close()
 
 
 if __name__ == "__main__":

@@ -33,6 +33,8 @@ from typing import Callable, Dict, Iterator, Optional
 import jax
 import numpy as np
 
+from sparknet_tpu import obs
+
 PREFETCH_COUNT = 3  # reference: data_layers.hpp PREFETCH_COUNT
 
 _log = logging.getLogger(__name__)
@@ -65,8 +67,15 @@ class Prefetcher:
         self._device_put = device_put
         self._sharding = sharding
         self._stall_timeout_s = stall_timeout_s
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        # named so traced producer spans get a labeled Perfetto track
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="prefetch-producer"
+        )
         self._thread.start()
+
+    def qsize(self) -> int:
+        """Batches currently buffered (the feed-queue-depth gauge)."""
+        return self._q.qsize()
 
     def _put_politely(self, item) -> bool:
         """Bounded-queue put that keeps checking the stop flag — the
@@ -119,14 +128,23 @@ class Prefetcher:
             try:
                 item = self._q.get(timeout=self._stall_timeout_s)
             except queue.Empty:
-                raise PrefetchStall(
+                msg = (
                     "prefetch producer delivered nothing for %.1fs "
                     "(thread %s)"
                     % (
                         self._stall_timeout_s,
                         "alive" if self._thread.is_alive() else "DEAD",
                     )
-                ) from None
+                )
+                # telemetry: the stall counter ticks, the trace gets a
+                # tagged instant, and /healthz goes unhealthy until the
+                # next round completes (obs.report_healthy)
+                tm = obs.training_metrics()
+                if tm is not None:
+                    tm.feed_stalls.inc()
+                obs.instant("prefetch_stall", cat="fault", msg=msg)
+                obs.report_unhealthy("prefetch_stall: " + msg)
+                raise PrefetchStall(msg) from None
         if item is None:
             self._done = True  # sticky: keep raising after exhaustion/error
             if self._error is not None:

@@ -50,6 +50,7 @@ from typing import Callable, Dict, Optional
 import jax
 import numpy as np
 
+from sparknet_tpu import obs
 from sparknet_tpu.data.prefetch import (  # noqa: F401  (re-exported)
     PREFETCH_COUNT,
     Prefetcher,
@@ -157,14 +158,20 @@ class RoundFeed:
         return jax.device_put(host)
 
     def _produce_one(self, r: int):
-        host = self._assemble(r, self._buf if self._recycle else None)
-        dev = self._place(host)
-        if self._recycle:
-            # the H2D copy must complete before the buffer is refilled;
-            # blocking HERE keeps the wait on the producer thread, still
-            # fully overlapped with the consumer's round execute
-            jax.block_until_ready(dev)
-            self._buf = host  # adopt (first round) / keep the buffer
+        # spans land on the PRODUCER thread when pipelined, so a trace
+        # shows round r+1's assemble/h2d bars interleaving under the
+        # consumer thread's execute bar — the overlap, visually
+        with obs.span("assemble", round=r):
+            host = self._assemble(r, self._buf if self._recycle else None)
+        with obs.span("h2d", round=r):
+            dev = self._place(host)
+            if self._recycle:
+                # the H2D copy must complete before the buffer is
+                # refilled; blocking HERE keeps the wait on the producer
+                # thread, still fully overlapped with the consumer's
+                # round execute
+                jax.block_until_ready(dev)
+                self._buf = host  # adopt (first round) / keep the buffer
         return dev
 
     def _spawn(self, start_r: int):
@@ -208,6 +215,9 @@ class RoundFeed:
             if self._pf is None:
                 self._spawn(r)
             out = next(self._pf)
+        tm = obs.training_metrics()
+        if tm is not None and self._pf is not None:
+            tm.feed_queue_depth.set(self._pf.qsize())
         self._next_r = r + 1
         return out
 

@@ -48,6 +48,7 @@ class SnapshotCorrupt(RuntimeError):
 import jax
 import numpy as np
 
+from sparknet_tpu import obs
 from sparknet_tpu.io import caffemodel
 from sparknet_tpu.solver import Solver, TrainState
 
@@ -151,6 +152,13 @@ def _write_snapshot(
 ) -> Tuple[str, str]:
     """Host-side file writes of one snapshot (shared by the sync path
     and the AsyncCheckpointer worker); all files publish atomically."""
+    with obs.span("snapshot", iter=int(it), fmt=fmt):
+        return _write_snapshot_inner(fmt, prefix, it, blobs, leaves, net_name)
+
+
+def _write_snapshot_inner(
+    fmt: str, prefix: str, it: int, blobs, leaves, net_name: str
+) -> Tuple[str, str]:
     os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
     if fmt == "HDF5":
         from sparknet_tpu.io import hdf5
@@ -185,6 +193,9 @@ def _write_snapshot(
     # leaves a manifest-less (pre-format) snapshot, never a manifest
     # that vouches for half-written data
     _write_manifest(it, fmt, (model_path, state_path))
+    tm = obs.training_metrics()
+    if tm is not None:
+        tm.snapshots.inc()
     return model_path, state_path
 
 
@@ -283,9 +294,26 @@ def restore(
     ``.solverstate.npz`` or ``.solverstate.h5`` path.  When the snapshot
     carries a manifest, its CRC32s are checked first (``verify=False``
     opts out, e.g. for forensics on a quarantined file)."""
+    with obs.span(
+        "restore", path=os.path.basename(prefix_or_state_path)
+    ):
+        state = _restore_impl(solver, prefix_or_state_path, seed, verify)
+    tm = obs.training_metrics()
+    if tm is not None:
+        tm.restores.inc()
+    return state
+
+
+def _restore_impl(
+    solver: Solver,
+    prefix_or_state_path: str,
+    seed: int = 0,
+    verify: bool = True,
+) -> TrainState:
     state_path = prefix_or_state_path
     if verify:
-        verify_snapshot(state_path)
+        with obs.span("verify", path=os.path.basename(state_path)):
+            verify_snapshot(state_path)
     fresh = solver.init_state(seed)
     leaves, treedef = _flatten_history(jax.device_get(fresh.history))
     if state_path.endswith(".solverstate.h5"):
@@ -350,6 +378,12 @@ def _quarantine(state_path: str) -> List[str]:
         if os.path.exists(p):
             os.replace(p, p + ".corrupt")
             moved.append(p + ".corrupt")
+    tm = obs.training_metrics()
+    if tm is not None:
+        tm.quarantined.inc()
+    obs.instant(
+        "quarantine", cat="fault", snapshot=os.path.basename(state_path)
+    )
     return moved
 
 

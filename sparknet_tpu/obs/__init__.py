@@ -1,0 +1,334 @@
+"""Unified telemetry layer — tracing, metrics, and the /metrics sidecar.
+
+One observability surface shared by training, the data plane, and
+serving (ARCHITECTURE.md "Observability"):
+
+- ``obs.metrics``  — Counter/Gauge/Histogram (+ label families) and the
+  Prometheus-text ``MetricsRegistry``; ``serve.metrics`` re-exports it.
+- ``obs.trace``    — low-overhead ``span()``/``instant()`` emitting
+  Chrome trace-event JSON (Perfetto-loadable, thread-correct) plus a
+  structured JSONL run log.
+- ``obs.exporter`` — the opt-in ``/metrics`` + ``/healthz`` HTTP
+  sidecar every ``cli train``/app run gets via ``--obs``.
+
+Instrumented code calls the module-level hooks (``obs.span``,
+``obs.instant``, ``obs.training_metrics()``, ``obs.fault``), which are
+near-free no-ops until ``obs.start(...)`` — wired to ``--obs`` /
+``--trace_out`` flags by ``add_cli_args``/``start_from_args`` — turns
+them on.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Optional
+
+from sparknet_tpu.obs.exporter import JsonHTTPHandler, ObsExporter  # noqa: F401
+from sparknet_tpu.obs.metrics import (  # noqa: F401
+    LATENCY_BUCKETS_S,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricFamily,
+    MetricsRegistry,
+)
+from sparknet_tpu.obs.trace import (  # noqa: F401
+    Tracer,
+    get_tracer,
+    install_tracer,
+    instant,
+    jsonl_path_for,
+    set_phase_observer,
+    span,
+    uninstall_tracer,
+)
+
+DEFAULT_OBS_PORT = 8380
+
+# jitted callables whose _cache_size() feeds the jit-cache gauge; weak
+# references, bounded — trainers register on construction and a bench
+# that builds dozens must not pin them all in memory
+_tracked_jits: "deque" = deque(maxlen=8)
+
+
+def track_jit(jitted) -> None:
+    """Register a jitted callable for the ``sparknet_jit_cache_size``
+    gauge (sum of ``_cache_size()`` over the most recent registrants)."""
+    try:
+        _tracked_jits.append(weakref.ref(jitted))
+    except TypeError:  # not weakref-able: skip rather than leak
+        pass
+
+
+def _jit_cache_size() -> int:
+    total = 0
+    for ref in list(_tracked_jits):
+        fn = ref()
+        if fn is None:
+            continue
+        try:
+            total += int(fn._cache_size())
+        except Exception:
+            pass
+    return total
+
+
+def _device_bytes() -> float:
+    """Bytes held by live jax arrays on this process's devices; guarded
+    — any backend that can't report (or a mid-teardown runtime) reads 0
+    rather than poisoning a scrape."""
+    try:
+        import jax
+
+        return float(sum(a.nbytes for a in jax.live_arrays()))
+    except Exception:
+        return 0.0
+
+
+def _host_rss_bytes() -> float:
+    try:
+        import resource
+
+        return float(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        )
+    except Exception:
+        return 0.0
+
+
+class TrainingMetrics:
+    """The training-side series, registered once per process on the
+    shared registry (the serving stack registers its own ``serve_*``
+    series on its registry the same way)."""
+
+    def __init__(self, registry: MetricsRegistry):
+        self.registry = registry
+        t0 = time.monotonic()
+        self.uptime = registry.gauge(
+            "sparknet_uptime_seconds", "seconds since telemetry start",
+            fn=lambda: time.monotonic() - t0,
+        )
+        self.rounds = registry.counter(
+            "sparknet_rounds_total",
+            "training rounds completed (rate() gives rounds/s)",
+        )
+        self.iters = registry.counter(
+            "sparknet_iters_total", "solver iterations completed"
+        )
+        self.phase_latency = registry.histogram(
+            "sparknet_phase_latency_seconds",
+            "wall seconds per round phase (assemble/h2d/execute/average/"
+            "snapshot/restore)",
+            labels=("phase",),
+        )
+        self.feed_queue_depth = registry.gauge(
+            "sparknet_feed_queue_depth",
+            "device batches ready in the round-feed prefetch queue",
+        )
+        self.feed_stalls = registry.counter(
+            "sparknet_feed_stalls_total",
+            "PrefetchStall watchdog fires (producer silent past timeout)",
+        )
+        self.retries = registry.counter(
+            "sparknet_io_retries_total",
+            "retry_call attempts that failed and were rescheduled",
+        )
+        self.snapshots = registry.counter(
+            "sparknet_snapshots_total", "checkpoints written"
+        )
+        self.restores = registry.counter(
+            "sparknet_restores_total", "checkpoints restored"
+        )
+        self.quarantined = registry.counter(
+            "sparknet_snapshots_quarantined_total",
+            "corrupt snapshots renamed *.corrupt by restore_newest_valid",
+        )
+        self.faults = registry.counter(
+            "sparknet_faults_total",
+            "chaos-injected faults observed, by kind",
+            labels=("kind",),
+        )
+        self.jit_cache = registry.gauge(
+            "sparknet_jit_cache_size",
+            "compiled programs behind tracked jitted fns (constant "
+            "after warmup iff no recompiles)",
+            fn=_jit_cache_size,
+        )
+        self.device_bytes = registry.gauge(
+            "sparknet_device_bytes",
+            "bytes held by live jax arrays (jax.live_arrays accounting)",
+            fn=_device_bytes,
+        )
+        self.host_rss = registry.gauge(
+            "sparknet_host_rss_bytes", "peak resident set size",
+            fn=_host_rss_bytes,
+        )
+
+
+_lock = threading.Lock()
+_training: Optional[TrainingMetrics] = None
+_unhealthy_reason: Optional[str] = None
+
+
+def enable_training_metrics() -> TrainingMetrics:
+    """Create (idempotently) the process-wide training registry +
+    series, and wire phase-cat spans into the per-phase histogram."""
+    global _training
+    with _lock:
+        if _training is None:
+            _training = TrainingMetrics(MetricsRegistry())
+            fam = _training.phase_latency
+            set_phase_observer(
+                lambda name, dur_s: fam.labels(name).observe(dur_s)
+            )
+    return _training
+
+
+def training_metrics() -> Optional[TrainingMetrics]:
+    """The enabled training metrics, or None — instrumented code guards
+    with one read: ``tm = obs.training_metrics();  if tm: ...``."""
+    return _training
+
+
+def _reset_training_metrics_for_tests() -> None:
+    """Drop the process singleton so a test gets fresh counters; NOT
+    for production code (instrumented sites cache nothing, so the swap
+    is safe mid-process)."""
+    global _training, _unhealthy_reason
+    with _lock:
+        _training = None
+        _unhealthy_reason = None
+        set_phase_observer(None)
+
+
+def fault(kind: str, **args) -> None:
+    """Tag a fault: an instant event on the trace (so fault ->
+    recovery latency is readable off the timeline) + the per-kind
+    counter when metrics are on."""
+    instant(f"fault_{kind}", cat="fault", **args)
+    tm = _training
+    if tm is not None:
+        tm.faults.labels(kind).inc()
+
+
+def report_unhealthy(reason: str) -> None:
+    """Flip /healthz to 503 (stalled feed / wedged round)."""
+    global _unhealthy_reason
+    _unhealthy_reason = reason
+
+
+def report_healthy() -> None:
+    """A round completed: clear the unhealthy flag."""
+    global _unhealthy_reason
+    if _unhealthy_reason is not None:
+        _unhealthy_reason = None
+
+
+def health_reason() -> Optional[str]:
+    return _unhealthy_reason
+
+
+# ----------------------------------------------------------------------
+# CLI wiring: every training entry point gets the same two flags
+
+
+def add_cli_args(parser) -> None:
+    parser.add_argument(
+        "--obs", action="store_true",
+        help="serve live Prometheus /metrics + /healthz for this run "
+        "(sidecar on --obs_port)",
+    )
+    parser.add_argument(
+        "--obs_port", type=int, default=DEFAULT_OBS_PORT,
+        help="telemetry sidecar port (0 = ephemeral)",
+    )
+    parser.add_argument(
+        "--trace_out", "--trace-out", default=None, metavar="TRACE.json",
+        help="write a Chrome trace (load in Perfetto: ui.perfetto.dev) "
+        "of round phases to this path, plus a .jsonl structured run log",
+    )
+
+
+class ObsRun:
+    """Handle for one run's telemetry; ``close()`` is idempotent —
+    stops the sidecar and writes the trace file.
+
+    Deliberately NOT torn down: the training-metrics registry and the
+    span->histogram observer.  They are process-wide and shared (the
+    Prometheus model: counters are cumulative over the PROCESS's
+    lifetime and survive run boundaries — ``rate()`` handles restarts;
+    a later ``--obs`` run in the same process scrapes continuing
+    totals, not zeros).  The residual cost of the observer once metrics
+    have ever been enabled is one histogram observe per phase span —
+    microseconds per round (measured in ``OBS_r09.json``)."""
+
+    def __init__(self, exporter=None, tracer=None, trace_out=None,
+                 metrics: Optional[TrainingMetrics] = None):
+        self.exporter = exporter
+        self.tracer = tracer
+        self.trace_out = trace_out
+        self.metrics = metrics
+        self._closed = False
+
+    @property
+    def address(self):
+        return self.exporter.address if self.exporter is not None else None
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self.exporter is not None:
+            self.exporter.close()
+        if self.tracer is not None:
+            if get_tracer() is self.tracer:
+                uninstall_tracer()
+            if self.trace_out:
+                self.tracer.save(self.trace_out)
+            self.tracer.close()
+
+
+def start(
+    metrics: bool = False,
+    port: int = DEFAULT_OBS_PORT,
+    host: str = "127.0.0.1",
+    trace_out: Optional[str] = None,
+    echo=print,
+) -> ObsRun:
+    """Turn telemetry on for this run: ``metrics=True`` starts the
+    /metrics + /healthz sidecar; ``trace_out`` installs the tracer.
+    Either switch also enables the training metric series (spans feed
+    the per-phase histogram).  Returns an ``ObsRun`` to ``close()`` in
+    the run's ``finally``."""
+    if not metrics and not trace_out:
+        return ObsRun()
+    tm = enable_training_metrics()
+    exporter = None
+    if metrics:
+        exporter = ObsExporter(
+            tm.registry, host=host, port=port, health_fn=health_reason
+        ).start()
+        if echo is not None:
+            h, p = exporter.address
+            echo(f"obs: serving /metrics and /healthz on http://{h}:{p}")
+    tracer = None
+    if trace_out:
+        tracer = install_tracer(Tracer(jsonl_path=jsonl_path_for(trace_out)))
+        if echo is not None:
+            echo(
+                f"obs: tracing round phases -> {trace_out} "
+                f"(+ {jsonl_path_for(trace_out)})"
+            )
+    return ObsRun(exporter, tracer, trace_out, tm)
+
+
+def start_from_args(args, echo=print) -> ObsRun:
+    return start(
+        metrics=getattr(args, "obs", False),
+        port=getattr(args, "obs_port", DEFAULT_OBS_PORT),
+        trace_out=getattr(args, "trace_out", None),
+        echo=echo,
+    )

@@ -1,0 +1,123 @@
+"""Opt-in telemetry sidecar: ``/metrics`` + ``/healthz`` for ANY run.
+
+The serving stack has always exposed Prometheus text at ``/metrics``
+(``serve/server.py``); this module gives the *training* side the same
+scrape surface as a tiny stdlib HTTP sidecar — ``cli train --obs`` and
+every app serve live rounds/s, per-phase latency, feed queue depth and
+memory gauges while they run.  ``/healthz`` flips to 503 when the run
+reports unhealthy (a ``PrefetchStall`` / stalled round — see
+``obs.report_unhealthy``), so an orchestrator can restart a wedged
+trainer the same way an LB drains a wedged replica.
+
+``JsonHTTPHandler`` is the handler machinery shared with the serving
+front-end (send/JSON helpers + quiet logging): ``serve/server.py``
+subclasses it rather than duplicating the plumbing.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from sparknet_tpu.obs.metrics import MetricsRegistry
+
+
+class JsonHTTPHandler(BaseHTTPRequestHandler):
+    """Shared request-handler plumbing: length-correct sends, JSON
+    helpers, HTTP/1.1 keep-alive, access logs off unless the bound
+    server context says otherwise."""
+
+    protocol_version = "HTTP/1.1"
+
+    def _verbose(self) -> bool:
+        return False
+
+    def log_message(self, fmt, *args):
+        if self._verbose():
+            print(self.__class__.__name__ + ": " + fmt % args)
+
+    def _send(self, code: int, payload: bytes, ctype: str,
+              extra_headers=()) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        for k, v in extra_headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _send_json(self, code: int, obj, extra_headers=()) -> None:
+        self._send(
+            code, json.dumps(obj).encode("utf-8"), "application/json",
+            extra_headers,
+        )
+
+
+class _ObsHandler(JsonHTTPHandler):
+    exporter: "ObsExporter"  # bound per-server via the factory below
+
+    def do_GET(self):
+        ex = self.exporter
+        if self.path == "/metrics":
+            self._send(
+                200,
+                ex.registry.render().encode("utf-8"),
+                "text/plain; version=0.0.4",
+            )
+        elif self.path == "/healthz":
+            reason = ex.health_fn() if ex.health_fn is not None else None
+            if reason:
+                self._send_json(503, {"status": "unhealthy", "reason": reason})
+            else:
+                self._send_json(200, {"status": "ok"})
+        else:
+            self._send_json(404, {"error": f"no route {self.path}"})
+
+
+class ObsExporter:
+    """Background ``/metrics`` + ``/healthz`` listener over a shared
+    ``MetricsRegistry``.  ``health_fn() -> Optional[str]`` returns an
+    unhealthy-reason string (None = healthy); port 0 binds an ephemeral
+    port (tests), resolved via ``address``."""
+
+    def __init__(
+        self,
+        registry: MetricsRegistry,
+        host: str = "127.0.0.1",
+        port: int = 8380,
+        health_fn: Optional[Callable[[], Optional[str]]] = None,
+    ):
+        self.registry = registry
+        self.health_fn = health_fn
+        ex = self
+
+        class BoundHandler(_ObsHandler):
+            exporter = ex
+
+        self.httpd = ThreadingHTTPServer((host, port), BoundHandler)
+        self.httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self):
+        """(host, port) actually bound (port 0 resolves here)."""
+        return self.httpd.server_address[:2]
+
+    def start(self) -> "ObsExporter":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            name="obs-exporter",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None

@@ -35,6 +35,7 @@ try:  # jax >= 0.6 exposes shard_map at top level
 except AttributeError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
+from sparknet_tpu import obs
 from sparknet_tpu.solver import Solver, TrainState
 from sparknet_tpu.utils.rngs import train_key
 
@@ -206,6 +207,7 @@ class ParameterAveragingTrainer:
             ),
             donate_argnums=(0, 1),
         )
+        obs.track_jit(self._round)  # feeds the jit-cache gauge
         # per-mask placed live masks, cached: the chaos/degraded loops
         # pass the SAME mask for many consecutive rounds, and the
         # all-alive default mask is placed exactly once
@@ -301,14 +303,25 @@ class ParameterAveragingTrainer:
         partition degrades throughput, never the weights.  ``None``
         means all alive (identical numerics to the unmasked round)."""
         rng = rng if rng is not None else train_key(0)
-        if live_mask is None:
-            live_mask = np.ones((self.num_workers,), np.float32)
-        live = self._place_live(live_mask)  # cached per mask value
-        state, losses = self._round(state, batches, rng, live)
-        # recorded lazily: smoothed_loss pulls the worker-mean of the
-        # addressable shards on read (Solver._drain_losses) — no
-        # device->host sync in the round loop
-        self.solver.note_losses(losses)
+        # "average" is the whole averaging round (this method IS one
+        # round of the SparkNet algorithm); "execute" nests inside it as
+        # the fused XLA program's dispatch/execution.  Span timing stays
+        # dispatch-honest: no extra device sync is added here.
+        with obs.span("average"):
+            if live_mask is None:
+                live_mask = np.ones((self.num_workers,), np.float32)
+            live = self._place_live(live_mask)  # cached per mask value
+            with obs.span("execute"):
+                state, losses = self._round(state, batches, rng, live)
+            # recorded lazily: smoothed_loss pulls the worker-mean of the
+            # addressable shards on read (Solver._drain_losses) — no
+            # device->host sync in the round loop
+            self.solver.note_losses(losses)
+        tm = obs.training_metrics()
+        if tm is not None:
+            tm.rounds.inc()
+            tm.iters.inc(losses.shape[-1])  # tau (shape read: no sync)
+        obs.report_healthy()  # a completed round clears /healthz
         return state, losses
 
     def test_and_store_result(
@@ -404,6 +417,7 @@ class AllReduceTrainer:
             out_shardings=(state_shardings, repl),
         )
         self._batch_sharding = batch_sharding
+        obs.track_jit(self._jit_round)  # feeds the jit-cache gauge
 
     @property
     def batch_sharding(self):
@@ -446,7 +460,13 @@ class AllReduceTrainer:
         """tau synchronous steps on a globally-sharded batch
         (batches[blob]: (tau, global_B, ...))."""
         rng = rng if rng is not None else train_key(0)
-        batches = jax.device_put(batches, self._batch_sharding)
-        state, losses = self._jit_round(state, batches, rng)
-        self.solver.note_losses(losses)
+        with obs.span("execute"):
+            batches = jax.device_put(batches, self._batch_sharding)
+            state, losses = self._jit_round(state, batches, rng)
+            self.solver.note_losses(losses)
+        tm = obs.training_metrics()
+        if tm is not None:
+            tm.rounds.inc()
+            tm.iters.inc(losses.shape[0])  # tau (shape read: no sync)
+        obs.report_healthy()
         return state, losses

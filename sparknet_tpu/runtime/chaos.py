@@ -40,6 +40,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from sparknet_tpu import obs as _obs
 from sparknet_tpu.utils import retry as _retry
 
 
@@ -184,6 +185,7 @@ class _Feed:
             if self._faults.get(r, 0) > 0:
                 self._faults[r] -= 1
                 self.counters["storage_injected"] += 1
+                _obs.fault("storage", round=r)
                 raise ConnectionResetError(
                     f"chaos: storage fault in round {r} fetch"
                 )
@@ -191,6 +193,7 @@ class _Feed:
                 self._stalls.discard(r)
                 self.counters["stalls_injected"] += 1
                 self.events.append(f"round {r}: producer stalled {self.plan.stall_s}s")
+                _obs.fault("stall", round=r, stall_s=self.plan.stall_s)
                 time.sleep(self.plan.stall_s)
             return self._build(r)
 
@@ -206,6 +209,8 @@ class _Feed:
             self.events.append(
                 f"round {r}: retry layer healed {healed} storage fault(s)"
             )
+            # fault -> recovery is two tagged instants on the trace
+            _obs.instant("recovered", kind="storage", round=r, healed=healed)
         return out
 
     def _spawn(self, start_r: int):
@@ -393,6 +398,7 @@ def run_chaos(
         mask = live_mask_for(r)
         if mask is not None and r == plan.dead_from_round:
             counters["dead_worker_injected"] = 1
+            _obs.fault("dead_worker", round=r, worker=plan.dead_worker)
             note(
                 f"round {r}: dp worker {plan.dead_worker} died; "
                 "averaging over survivors"
@@ -424,6 +430,7 @@ def run_chaos(
                 counters["preempt_injected"] = 1
                 t_preempt = time.perf_counter()
                 preempted_at = r
+                _obs.fault("preemption", round=r)
                 note(f"round {r}: SIGHUP preemption — simulated process death")
                 break
     feed.close()
@@ -438,6 +445,9 @@ def run_chaos(
             newest = checkpoint.find_snapshots(prefix)[-1]
             corrupt_file(newest, seed=plan.seed)
             counters["corruption_injected"] = 1
+            _obs.fault(
+                "snapshot_corruption", snapshot=os.path.basename(newest)
+            )
             note(f"corrupted newest snapshot {os.path.basename(newest)}")
         st, used = checkpoint.restore_newest_valid(solver, prefix)
         resumed_from_iter = int(np.asarray(st.iter))
@@ -456,6 +466,11 @@ def run_chaos(
         state = broadcast(st)
         recovery_latency_s = time.perf_counter() - t_preempt
         counters["preempt_survived"] = 1
+        _obs.instant(
+            "recovered", kind="preemption",
+            latency_s=round(recovery_latency_s, 3),
+            resumed_iter=resumed_from_iter,
+        )
         start_round = resumed_from_iter // plan.tau
         note(
             "resumed at round %d (iter %d) in %.2fs; replaying %d round(s)"

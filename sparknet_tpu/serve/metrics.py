@@ -1,221 +1,19 @@
-"""Serving metrics: counters/gauges/histograms + Prometheus text export.
+"""Serving metrics — thin re-export of the shared observability layer.
 
-Stdlib-only (no prometheus_client in the image): each metric is a small
-lock-guarded accumulator, and ``MetricsRegistry.render()`` emits the
-Prometheus text exposition format (``# HELP``/``# TYPE`` + samples) that
-``/metrics`` serves.  Histograms keep cumulative buckets (the Prometheus
-``le`` convention) plus a bounded reservoir of recent observations so
-p50/p95/p99 can be reported without a scrape-side quantile engine.
+The Counter/Gauge/Histogram instruments and the Prometheus-text
+``MetricsRegistry`` were born here in round 6; round 9 promoted them to
+``sparknet_tpu/obs/metrics.py`` so training and serving register series
+on ONE implementation (the training sidecar and the serving front-end
+render the identical exposition format).  Import from either path;
+this module exists so serving call sites never changed.
 """
 
-from __future__ import annotations
-
-import threading
-from typing import Dict, List, Optional, Sequence, Tuple
-
-# default latency buckets (seconds): 1 ms .. 30 s, roughly log-spaced
-LATENCY_BUCKETS_S = (
-    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
-    1.0, 2.5, 5.0, 10.0, 30.0,
+from sparknet_tpu.obs.metrics import (  # noqa: F401
+    LATENCY_BUCKETS_S,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricFamily,
+    MetricsRegistry,
+    _fmt,
 )
-
-
-def _fmt(v: float) -> str:
-    """Prometheus sample value: integers print bare, floats as repr."""
-    f = float(v)
-    return str(int(f)) if f == int(f) else repr(f)
-
-
-class Counter:
-    """Monotonic counter (``requests_total`` style)."""
-
-    TYPE = "counter"
-
-    def __init__(self, name: str, help: str = ""):
-        self.name, self.help = name, help
-        self._lock = threading.Lock()
-        self._value = 0.0
-
-    def inc(self, n: float = 1.0) -> None:
-        with self._lock:
-            self._value += n
-
-    @property
-    def value(self) -> float:
-        with self._lock:
-            return self._value
-
-    def samples(self) -> List[Tuple[str, float]]:
-        return [(self.name, self.value)]
-
-
-class Gauge:
-    """Set-to-current-value metric (``queue_depth`` style); ``fn`` makes
-    it a callback gauge sampled at render time."""
-
-    TYPE = "gauge"
-
-    def __init__(self, name: str, help: str = "", fn=None):
-        self.name, self.help = name, help
-        self._lock = threading.Lock()
-        self._value = 0.0
-        self._fn = fn
-
-    def set(self, v: float) -> None:
-        with self._lock:
-            self._value = float(v)
-
-    def inc(self, n: float = 1.0) -> None:
-        with self._lock:
-            self._value += n
-
-    def dec(self, n: float = 1.0) -> None:
-        self.inc(-n)
-
-    @property
-    def value(self) -> float:
-        if self._fn is not None:
-            return float(self._fn())
-        with self._lock:
-            return self._value
-
-    def samples(self) -> List[Tuple[str, float]]:
-        return [(self.name, self.value)]
-
-
-class Histogram:
-    """Cumulative-bucket histogram + bounded reservoir for quantiles.
-
-    The reservoir is a ring of the last ``reservoir`` observations —
-    quantiles are over the recent window, which is what a serving
-    dashboard wants (steady-state p99, not cold-start-polluted
-    all-time p99).
-    """
-
-    TYPE = "histogram"
-
-    def __init__(
-        self,
-        name: str,
-        help: str = "",
-        buckets: Sequence[float] = LATENCY_BUCKETS_S,
-        reservoir: int = 4096,
-    ):
-        self.name, self.help = name, help
-        self.buckets = tuple(sorted(buckets))
-        self._lock = threading.Lock()
-        self._counts = [0] * (len(self.buckets) + 1)  # +inf tail
-        self._sum = 0.0
-        self._count = 0
-        self._ring: List[float] = []
-        self._ring_cap = int(reservoir)
-        self._ring_pos = 0
-        # sorted view of the ring, built lazily on the first quantile
-        # read and kept until the next observation — a scrape reading
-        # p50/p95/p99 sorts ONCE, not once per quantile
-        self._sorted: Optional[List[float]] = None
-
-    def observe(self, v: float) -> None:
-        v = float(v)
-        with self._lock:
-            i = 0
-            for i, le in enumerate(self.buckets):
-                if v <= le:
-                    break
-            else:
-                i = len(self.buckets)
-            self._counts[i] += 1
-            self._sum += v
-            self._count += 1
-            if len(self._ring) < self._ring_cap:
-                self._ring.append(v)
-            else:
-                self._ring[self._ring_pos] = v
-                self._ring_pos = (self._ring_pos + 1) % self._ring_cap
-            self._sorted = None  # invalidate the cached sorted view
-
-    @property
-    def count(self) -> int:
-        with self._lock:
-            return self._count
-
-    @property
-    def sum(self) -> float:
-        with self._lock:
-            return self._sum
-
-    def mean(self) -> float:
-        with self._lock:
-            return self._sum / self._count if self._count else 0.0
-
-    def quantile(self, q: float) -> float:
-        """q in [0, 1] over the recent-observation reservoir (0.0 when
-        empty); nearest-rank on the sorted window.  The sort happens at
-        most once per observation batch: consecutive quantile reads
-        (p50/p95/p99 in one scrape) share the cached sorted view, which
-        ``observe`` invalidates."""
-        with self._lock:
-            if self._sorted is None:
-                self._sorted = sorted(self._ring)
-            window = self._sorted  # replaced, never mutated, on observe
-        if not window:
-            return 0.0
-        idx = min(len(window) - 1, max(0, int(q * len(window))))
-        return window[idx]
-
-    def samples(self) -> List[Tuple[str, float]]:
-        with self._lock:
-            counts, total, s = list(self._counts), self._count, self._sum
-        out: List[Tuple[str, float]] = []
-        cum = 0
-        for le, c in zip(self.buckets, counts):
-            cum += c
-            out.append((f'{self.name}_bucket{{le="{_fmt(le)}"}}', cum))
-        out.append((f'{self.name}_bucket{{le="+Inf"}}', total))
-        out.append((f"{self.name}_sum", s))
-        out.append((f"{self.name}_count", total))
-        return out
-
-
-class MetricsRegistry:
-    """Holds the serving metrics and renders the /metrics payload."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._metrics: Dict[str, object] = {}
-
-    def register(self, metric):
-        with self._lock:
-            if metric.name in self._metrics:
-                raise ValueError(f"duplicate metric {metric.name!r}")
-            self._metrics[metric.name] = metric
-        return metric
-
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self.register(Counter(name, help))
-
-    def gauge(self, name: str, help: str = "", fn=None) -> Gauge:
-        return self.register(Gauge(name, help, fn=fn))
-
-    def histogram(
-        self, name: str, help: str = "",
-        buckets: Sequence[float] = LATENCY_BUCKETS_S,
-    ) -> Histogram:
-        return self.register(Histogram(name, help, buckets=buckets))
-
-    def get(self, name: str) -> Optional[object]:
-        with self._lock:
-            return self._metrics.get(name)
-
-    def render(self) -> str:
-        """Prometheus text exposition format, version 0.0.4."""
-        with self._lock:
-            metrics = list(self._metrics.values())
-        lines: List[str] = []
-        for m in metrics:
-            if m.help:
-                lines.append(f"# HELP {m.name} {m.help}")
-            lines.append(f"# TYPE {m.name} {m.TYPE}")
-            for sample_name, value in m.samples():
-                lines.append(f"{sample_name} {_fmt(value)}")
-        return "\n".join(lines) + "\n"

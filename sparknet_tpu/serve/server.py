@@ -25,41 +25,30 @@ import json
 import signal as _signal
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
 from typing import Optional
 
 import numpy as np
 
+from sparknet_tpu.obs.exporter import JsonHTTPHandler
 from sparknet_tpu.serve.batcher import MicroBatcher, QueueFull
 from sparknet_tpu.serve.engine import InferenceEngine
 from sparknet_tpu.utils.signals import SignalHandler, SolverAction
 
 
-class _Handler(BaseHTTPRequestHandler):
+class _Handler(JsonHTTPHandler):
+    """Send/JSON plumbing comes from the shared obs handler machinery
+    (the training /metrics sidecar runs the same base class)."""
+
     # set per-server via the factory in ServeServer
     server_ctx: "ServeServer"
-    protocol_version = "HTTP/1.1"
 
-    def log_message(self, fmt, *args):  # route access logs to the app
-        if self.server_ctx.verbose:
+    def _verbose(self) -> bool:  # route access logs to the app
+        return self.server_ctx.verbose
+
+    def log_message(self, fmt, *args):
+        if self._verbose():
             print("serve: " + fmt % args)
-
-    # ------------------------------------------------------------------
-    def _send(self, code: int, payload: bytes, ctype: str,
-              extra_headers=()) -> None:
-        self.send_response(code)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(payload)))
-        for k, v in extra_headers:
-            self.send_header(k, v)
-        self.end_headers()
-        self.wfile.write(payload)
-
-    def _send_json(self, code: int, obj, extra_headers=()) -> None:
-        self._send(
-            code, json.dumps(obj).encode("utf-8"), "application/json",
-            extra_headers,
-        )
 
     # ------------------------------------------------------------------
     def do_GET(self):
@@ -97,6 +86,15 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path != "/predict":
             self._send_json(404, {"error": f"no route {self.path}"})
             return
+        # the open-request gauge covers the full front-end residency of
+        # a /predict (parse + queue wait + inference + serialize)
+        ctx.m_open_requests.inc()
+        try:
+            self._predict(ctx, raw)
+        finally:
+            ctx.m_open_requests.dec()
+
+    def _predict(self, ctx: "ServeServer", raw: bytes) -> None:
         if ctx.draining:
             self._send_json(
                 503, {"status": "draining"},
@@ -186,6 +184,17 @@ class ServeServer:
             engine, max_queue=max_queue, max_wait_ms=max_wait_ms
         )
         self.metrics = self.batcher.metrics
+        # front-end series ride on the SAME shared registry the batcher
+        # built (obs.metrics) — one /metrics payload, no second registry
+        t0 = time.monotonic()
+        self.m_uptime = self.metrics.gauge(
+            "serve_uptime_seconds", "seconds since server construction",
+            fn=lambda: time.monotonic() - t0,
+        )
+        self.m_open_requests = self.metrics.gauge(
+            "serve_open_requests",
+            "in-flight /predict requests (parse + queue + inference)",
+        )
         self.request_timeout_s = float(request_timeout_s)
         self.verbose = verbose
         self._drain_evt = threading.Event()

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sparknet_tpu import obs
 from sparknet_tpu.config import load_net_prototxt
 from sparknet_tpu.config.schema import NetParameter, SolverParameter, solver_method
 from sparknet_tpu.net import JaxNet, Params, Stats
@@ -360,8 +361,16 @@ class Solver:
         if self.param.debug_info:
             first = jax.tree_util.tree_map(lambda x: x[0], batches)
             self.debug_info_pass(state, first, rng=rng)
-        state, losses = self._jit_step(state, batches, rng)
+        # the single-process round phase ("execute" in the obs span
+        # vocabulary — cli train's default path has no trainer wrapper)
+        with obs.span("execute"):
+            state, losses = self._jit_step(state, batches, rng)
         self.note_losses(losses)
+        tm = obs.training_metrics()
+        if tm is not None:
+            tm.rounds.inc()
+            tm.iters.inc(losses.shape[0])  # tau (shape read: no sync)
+        obs.report_healthy()
         return state, losses
 
     def note_losses(self, losses) -> None:

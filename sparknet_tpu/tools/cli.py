@@ -71,6 +71,18 @@ def cmd_train(args) -> int:
         )
         return 1
 
+    # telemetry first, so restore/snapshot spans and the /metrics
+    # sidecar cover the whole run (both flags off -> pure no-op)
+    from sparknet_tpu import obs
+
+    run_obs = obs.start_from_args(args)
+    try:
+        return _cmd_train(args)
+    finally:
+        run_obs.close()
+
+
+def _cmd_train(args) -> int:
     import jax
 
     from sparknet_tpu import config
@@ -789,6 +801,9 @@ def main(argv=None) -> int:
     p.add_argument(
         "--sighup_effect", choices=["stop", "snapshot", "none"], default="snapshot"
     )
+    from sparknet_tpu import obs as _obs
+
+    _obs.add_cli_args(p)  # --obs / --obs_port / --trace_out
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("test")

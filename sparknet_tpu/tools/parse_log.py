@@ -1,10 +1,17 @@
 """Training-log parser — the ``tools/extra/parse_log.py`` role.
 
-Parses this framework's ``training_log_<ts>*.txt`` format (elapsed
-seconds + structured phase messages, ``utils/trainlog.py``) into
-train/test row tables and CSV files, so training curves plot without
-ad-hoc grepping — the same workflow the reference's parse_log.py +
-plot_training_log.py serve for glog output.
+Parses BOTH experiment-record formats into train/test row tables and
+CSV files, so training curves plot without ad-hoc grepping — the same
+workflow the reference's parse_log.py + plot_training_log.py serve for
+glog output:
+
+- the flat ``training_log_<ts>*.txt`` format (elapsed seconds +
+  structured phase messages, ``utils/trainlog.py``), and
+- the structured JSONL run log the round-span tracer streams
+  (``obs/trace.py``; one JSON object per line) — ``TrainingLog`` lines
+  ride in it as ``{"kind": "instant", "name": "log", ...}`` records,
+  which are recognized with the SAME line matchers.  Span/other records
+  are skipped.
 
 Recognized lines:
 
@@ -17,8 +24,9 @@ Recognized lines:
 from __future__ import annotations
 
 import csv
+import json
 import re
-from typing import Dict, List, Tuple
+from typing import Dict, Iterable, Iterator, List, Tuple
 
 _TRAIN_ROUND = re.compile(
     r"^([\d.]+):\s+round\s+(\d+)\s+trained,\s+smoothed_loss\s+([-\d.eE]+)"
@@ -34,12 +42,42 @@ _ROUND_SCORE = re.compile(
 )
 
 
-def parse_log(path: str) -> Tuple[List[dict], List[dict]]:
-    """-> (train_rows, test_rows).
+def is_jsonl_log(path: str) -> bool:
+    """Structured-run-log sniff: the first non-blank line is a JSON
+    object (the flat format always starts ``<seconds>:``)."""
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                return line.startswith("{")
+    return False
 
-    train rows: {seconds, round_or_iter, smoothed_loss};
-    test rows: {seconds, <output name>: value, ...} — consecutive
-    ``test output`` lines at one timestamp merge into one row."""
+
+def _jsonl_to_lines(f: Iterable[str]) -> Iterator[str]:
+    """Reconstruct flat-format lines from JSONL ``log`` records (other
+    record kinds — spans, faults, retries — carry no train/test rows
+    and are skipped so they cannot split a pending test-row merge)."""
+    for raw in f:
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            rec = json.loads(raw)
+        except ValueError:
+            continue
+        if rec.get("name") != "log":
+            continue
+        args = rec.get("args") or {}
+        msg = args.get("msg", "")
+        sec = args.get("elapsed", rec.get("ts_s", 0.0))
+        i = args.get("i", -1)
+        if isinstance(i, (int, float)) and i >= 0:
+            yield f"{sec}, i = {int(i)}: {msg}"
+        else:
+            yield f"{sec}: {msg}"
+
+
+def _parse_lines(lines: Iterable[str]) -> Tuple[List[dict], List[dict]]:
     train: List[dict] = []
     test: List[dict] = []
     pending: Dict[str, float] = {}
@@ -51,39 +89,50 @@ def parse_log(path: str) -> Tuple[List[dict], List[dict]]:
             test.append({"seconds": pending_sec, **pending})
         pending, pending_sec = {}, None
 
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            m = _TEST_OUT.match(line)
-            if m:
-                sec = float(m.group(1))
-                if pending_sec is not None and sec != pending_sec:
-                    flush()
-                pending_sec = sec
-                pending[m.group(2)] = float(m.group(3))
-                continue
-            m = _TRAIN_ROUND.match(line) or _TRAIN_ITER.match(line)
-            if m:
+    for line in lines:
+        line = line.strip()
+        m = _TEST_OUT.match(line)
+        if m:
+            sec = float(m.group(1))
+            if pending_sec is not None and sec != pending_sec:
                 flush()
-                train.append(
-                    {
-                        "seconds": float(m.group(1)),
-                        "round_or_iter": int(m.group(2)),
-                        "smoothed_loss": float(m.group(3)),
-                    }
-                )
-                continue
-            m = _ROUND_SCORE.match(line)
-            if m:
-                # "round R, accuracy A" annotates the pending test row
-                if pending_sec is None:
-                    pending_sec = float(m.group(1))
-                pending.setdefault("round", int(m.group(2)))
-                pending[m.group(3)] = float(m.group(4))
-                continue
+            pending_sec = sec
+            pending[m.group(2)] = float(m.group(3))
+            continue
+        m = _TRAIN_ROUND.match(line) or _TRAIN_ITER.match(line)
+        if m:
             flush()
+            train.append(
+                {
+                    "seconds": float(m.group(1)),
+                    "round_or_iter": int(m.group(2)),
+                    "smoothed_loss": float(m.group(3)),
+                }
+            )
+            continue
+        m = _ROUND_SCORE.match(line)
+        if m:
+            # "round R, accuracy A" annotates the pending test row
+            if pending_sec is None:
+                pending_sec = float(m.group(1))
+            pending.setdefault("round", int(m.group(2)))
+            pending[m.group(3)] = float(m.group(4))
+            continue
+        flush()
     flush()
     return train, test
+
+
+def parse_log(path: str) -> Tuple[List[dict], List[dict]]:
+    """-> (train_rows, test_rows); auto-detects flat vs JSONL.
+
+    train rows: {seconds, round_or_iter, smoothed_loss};
+    test rows: {seconds, <output name>: value, ...} — consecutive
+    ``test output`` lines at one timestamp merge into one row."""
+    jsonl = is_jsonl_log(path)
+    with open(path) as f:
+        lines = _jsonl_to_lines(f) if jsonl else f
+        return _parse_lines(lines)
 
 
 def write_csvs(train: List[dict], test: List[dict], prefix: str) -> List[str]:

@@ -37,6 +37,8 @@ import urllib.error
 from dataclasses import dataclass
 from typing import Callable, Optional, TypeVar
 
+from sparknet_tpu import obs
+
 T = TypeVar("T")
 
 # OS-level errno values that mean "the far side hiccuped", not "you asked
@@ -189,6 +191,16 @@ def retry_call(
                 delay = max(delay, min(hint, policy.cap_s))
             if slept + delay > policy.budget_s:
                 break
+            # telemetry: every scheduled retry ticks the counter and
+            # tags the trace (no-ops when obs is off); the caller's
+            # on_retry still observes afterwards, unchanged
+            tm = obs.training_metrics()
+            if tm is not None:
+                tm.retries.inc()
+            obs.instant(
+                "retry", cat="io", attempt=attempt,
+                delay_ms=round(delay * 1e3, 2), error=type(e).__name__,
+            )
             if on_retry is not None:
                 on_retry(e, attempt, delay)
             slept += delay

@@ -13,10 +13,19 @@ use (conftest imports run before any test touches a device).
 
 import os
 import sys
+import tempfile
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
+
+# route TrainingLog output (tests that run apps/cli in-process or as
+# subprocesses) into a per-session tmpdir instead of littering the repo
+# root with training_log_*.txt; tests that pass directory=/path= still
+# win over the env default
+os.environ.setdefault(
+    "SPARKNET_LOG_DIR", tempfile.mkdtemp(prefix="sparknet_test_logs_")
+)
 
 from sparknet_tpu.utils.devices import force_virtual_cpu_devices  # noqa: E402
 

@@ -114,6 +114,7 @@ def test_unknown_mode_rejected():
     assert out.returncode != 0
     assert "unknown mode 'bogus'" in out.stderr
     assert "pipeline" in out.stderr  # the error lists the valid modes
+    assert "obs" in out.stderr  # ... including the telemetry mode
     # env-var route rejects identically
     out = subprocess.run(
         [sys.executable, os.path.join(_REPO, "bench.py")],
@@ -135,6 +136,140 @@ def test_pipeline_mode_smoke():
     assert rec["value"] > 1.0
     assert rec["pipelined_round_ms"] < rec["serial_round_ms"]
     assert rec["real"]["serial_round_ms"] > 0
+
+
+@pytest.mark.slow
+def test_obs_mode_smoke():
+    """bench.py --mode=obs end to end in a subprocess: one JSON line,
+    all three regimes timed, the produced trace audited."""
+    rec = _run_bench({
+        "BENCH_MODE": "obs", "BENCH_ROUNDS": "2", "BENCH_PASSES": "1",
+    })
+    assert rec["metric"] == "obs_tracing_overhead_pct"
+    assert rec["baseline_round_ms"] > 0
+    assert rec["traced_round_ms"] > 0
+    # the overhead itself is noise-bounded on a live CI box — the
+    # committed-artifact pin below enforces the <2% acceptance; here
+    # only sanity (no order-of-magnitude blowup from instrumentation)
+    assert rec["value"] < 25.0, rec
+    for name in ("assemble", "h2d", "execute", "average"):
+        assert rec["span_counts"].get(name, 0) >= rec["rounds"], name
+    assert rec["producer_thread_distinct"] is True
+    assert rec["producer_overlap_observed"] is True
+    assert rec["jsonl_lines"] > 0
+    assert rec["off_span_ns"] < 100_000  # a disabled span is sub-0.1ms
+
+
+_OBS_SCHEMA_KEYS = (
+    "metric", "value", "unit", "vs_baseline", "platform", "workers",
+    "tau", "batch", "rounds", "passes", "baseline_round_ms",
+    "metrics_round_ms", "traced_round_ms", "overhead_metrics_pct",
+    "overhead_traced_pct", "off_span_ns", "off_span_overhead_pct",
+    "span_counts", "producer_thread_distinct",
+    "producer_overlap_observed", "jsonl_lines",
+)
+
+
+def test_committed_obs_artifact_schema():
+    """OBS_r09.json — the telemetry-overhead committed artifact: the
+    traced run must sit inside the <2% acceptance budget, the disabled
+    span must measure as ~free, and the trace audit must show
+    producer-thread assembly spans overlapping consumer execute spans
+    (the Perfetto-visible pipelining proof)."""
+    with open(os.path.join(_REPO, "OBS_r09.json")) as f:
+        d = json.load(f)
+    for key in _OBS_SCHEMA_KEYS:
+        assert key in d, key
+    assert d["metric"] == "obs_tracing_overhead_pct"
+    # the acceptance bar: <2% with tracing on (noise can make it
+    # negative — the note discloses the box's drift floor)
+    assert d["value"] == d["overhead_traced_pct"] < 2.0
+    assert d["vs_baseline"] == round(d["value"] / 2.0, 3) <= 1.0
+    assert d["baseline_round_ms"] > 0 and d["traced_round_ms"] > 0
+    # '~0 when off', as a number: a disabled span costs microseconds,
+    # and the per-round share of the off path is below 0.1%
+    assert 0 < d["off_span_ns"] < 100_000
+    assert 0 <= d["off_span_overhead_pct"] < 0.1
+    # every phase span the tier-1 smoke asserts also rode the artifact
+    for name in ("assemble", "h2d", "execute", "average"):
+        assert d["span_counts"].get(name, 0) >= d["rounds"], name
+    assert d["producer_thread_distinct"] is True
+    assert d["producer_overlap_observed"] is True
+    assert d["jsonl_lines"] >= sum(d["span_counts"].values())
+
+
+def test_obs_traced_run_tier1_smoke(tmp_path):
+    """Tier-1 telemetry smoke (in-process, small): a short traced
+    cifar10_quick run on the virtual mesh produces a Perfetto-loadable
+    trace whose assemble/h2d/execute/average spans exist, nest sanely,
+    and attribute the producer phases to the feed thread."""
+    import jax
+
+    from sparknet_tpu import config as cfg, models, obs
+    from sparknet_tpu.data import CifarLoader, RoundFeed
+    from sparknet_tpu.obs.trace import Tracer
+    from sparknet_tpu.parallel import ParameterAveragingTrainer, make_mesh
+    from sparknet_tpu.solver import Solver
+
+    workers, tau, batch, rounds = 2, 1, 4, 3
+    data_dir = str(tmp_path / "data")
+    CifarLoader.write_synthetic(data_dir, num_train=32, num_test=8, seed=3)
+    xs, ys = CifarLoader(data_dir).minibatches(batch, train=True)
+
+    def window(r):
+        import numpy as np
+
+        data = np.stack([xs[(r * workers + w) % len(xs)] for w in range(workers)])
+        label = np.stack([ys[(r * workers + w) % len(ys)] for w in range(workers)])
+        return {"data": data[:, None], "label": label[:, None]}
+
+    netp = cfg.replace_data_layers(
+        models.load_model("cifar10_quick"),
+        [(batch, 3, 32, 32), (batch,)],
+        [(batch, 3, 32, 32), (batch,)],
+    )
+    solver = Solver(models.load_model_solver("cifar10_quick"), net_param=netp)
+    mesh = make_mesh({"dp": workers}, devices=jax.devices()[:workers])
+    trainer = ParameterAveragingTrainer(solver, mesh)
+    tracer = obs.install_tracer(Tracer())
+    feed = RoundFeed(lambda r, out: window(r), mesh=mesh, num_rounds=rounds)
+    try:
+        state = trainer.init_state(seed=0)
+        for r in range(rounds):
+            state, losses = trainer.round(state, feed.next_round(r))
+        jax.block_until_ready(losses)
+    finally:
+        feed.stop()
+        obs.uninstall_tracer()
+    path = str(tmp_path / "run.trace.json")
+    tracer.save(path)
+    with open(path) as f:
+        events = json.load(f)["traceEvents"]
+    spans = [e for e in events if e.get("ph") == "X"]
+    by_name = {}
+    for e in spans:
+        by_name.setdefault(e["name"], []).append(e)
+    for name in ("assemble", "h2d", "execute", "average"):
+        assert len(by_name.get(name, [])) == rounds, (name, by_name.keys())
+    # nesting: every execute sits inside exactly one average span on
+    # the SAME thread; assemble/h2d live on the producer thread
+    for exe in by_name["execute"]:
+        parents = [
+            a for a in by_name["average"]
+            if a["tid"] == exe["tid"]
+            and a["ts"] <= exe["ts"]
+            and exe["ts"] + exe["dur"] <= a["ts"] + a["dur"] + 1.0
+        ]
+        assert len(parents) == 1, exe
+    exec_tids = {e["tid"] for e in by_name["execute"]}
+    feed_tids = {e["tid"] for e in by_name["assemble"] + by_name["h2d"]}
+    assert exec_tids and feed_tids and not (exec_tids & feed_tids)
+    # per-round h2d follows its round's assemble on the producer
+    asm = sorted(by_name["assemble"], key=lambda e: e["ts"])
+    h2d = sorted(by_name["h2d"], key=lambda e: e["ts"])
+    for a, h in zip(asm, h2d):
+        assert a["args"]["round"] == h["args"]["round"]
+        assert a["ts"] + a["dur"] <= h["ts"] + 1.0
 
 
 _PIPELINE_SCHEMA_KEYS = (

@@ -205,6 +205,44 @@ def test_cli_train_devices_allreduce(tmp_path, toy_model, cifar_dir, capsys):
     assert "resumed from" in capsys.readouterr().out
 
 
+def test_cli_train_obs_flags_write_trace_and_serve_metrics(
+    tmp_path, toy_model, capsys
+):
+    """`train --obs --trace_out=...`: the run serves /metrics+/healthz
+    while training and leaves a Perfetto-loadable Chrome trace plus the
+    JSONL run log behind (ISSUE 4 wiring)."""
+    import json
+
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(
+        f'net: "{toy_model}"\nbase_lr: 0.01\nlr_policy: "fixed"\n'
+        "max_iter: 4\n"
+        f'snapshot_prefix: "{tmp_path}/obs"\n'
+    )
+    trace = str(tmp_path / "run.trace.json")
+    rc = cli.main([
+        "train", f"--solver={solver}", "--tau=2",
+        f"--trace_out={trace}", "--obs", "--obs_port=0",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "obs: serving /metrics and /healthz on http://" in out
+    assert f"obs: tracing round phases -> {trace}" in out
+    with open(trace) as f:
+        events = json.load(f)["traceEvents"]
+    names = {e["name"] for e in events if e.get("ph") == "X"}
+    # 2 rounds of tau=2: the feed's producer phases + the solver step
+    assert {"assemble", "h2d", "execute"} <= names, names
+    jsonl = trace[: -len(".json")] + ".jsonl"
+    recs = [json.loads(l) for l in open(jsonl)]
+    assert any(r["name"] == "execute" for r in recs)
+    # the TrainingLog smoothed-loss line rode the structured run log
+    assert any(
+        r["name"] == "log" and "smoothed_loss" in r["args"]["msg"]
+        for r in recs
+    )
+
+
 def test_cli_train_resume_conflicts_with_snapshot(tmp_path, toy_model, capsys):
     """--resume scans the solver's snapshot_prefix; naming an explicit
     --snapshot (or --weights) alongside it is a conflict, not a silent

@@ -30,6 +30,9 @@ def test_launcher_two_host_cifar(tmp_path):
         "PYTHONPATH": _REPO,
         "PALLAS_AXON_POOL_IPS": "",
         "JAX_PLATFORMS": "cpu",
+        # route each host's TrainingLog into this test's tmpdir (the
+        # conftest session default would otherwise swallow them)
+        "SPARKNET_LOG_DIR": str(tmp_path),
     }
     cmd = [
         sys.executable,

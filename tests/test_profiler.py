@@ -1,0 +1,64 @@
+"""utils/profiler.py coverage (previously untested): the `caffe time`
+analog must produce a per-layer table of finite timings plus positive
+fused whole-net numbers on a real zoo model."""
+
+import numpy as np
+import pytest
+
+from sparknet_tpu import config, models
+from sparknet_tpu.net import JaxNet
+from sparknet_tpu.utils.profiler import format_profile, profile_net
+
+_BATCH = 2
+
+
+@pytest.fixture(scope="module")
+def quick_net():
+    netp = config.replace_data_layers(
+        models.load_model("cifar10_quick"),
+        [(_BATCH, 3, 32, 32), (_BATCH,)],
+        [(_BATCH, 3, 32, 32), (_BATCH,)],
+    )
+    net = JaxNet(netp, phase="TRAIN")
+    params, stats = net.init(0)
+    rng = np.random.RandomState(0)
+    batch = {
+        "data": rng.randn(_BATCH, 3, 32, 32).astype(np.float32),
+        "label": rng.randint(0, 10, _BATCH).astype(np.float32),
+    }
+    return net, params, stats, batch
+
+
+def test_profile_net_table_shape_and_times(quick_net):
+    net, params, stats, batch = quick_net
+    result = profile_net(net, params, stats, batch, iterations=1)
+    layers = result["layers"]
+    # every non-data layer gets a row with both timing columns
+    from sparknet_tpu.ops import data_layers
+
+    expected = {
+        l.name for l in net.layers
+        if not isinstance(l, data_layers._HostFed)
+    }
+    assert set(layers) == expected and expected
+    for name, row in layers.items():
+        assert set(row) == {"forward_ms", "backward_ms"}, name
+        assert row["forward_ms"] > 0, name
+        # backward is NaN only for non-differentiable layers (Accuracy)
+        assert row["backward_ms"] > 0 or np.isnan(row["backward_ms"]), name
+    # the conv layers must be differentiable (real backward numbers)
+    assert result["layers"]["conv1"]["backward_ms"] > 0
+    # fused whole-net times are the honest end-to-end numbers
+    assert result["total_forward_ms"] > 0
+    assert result["total_fwdbwd_ms"] > 0
+
+
+def test_format_profile_renders_table(quick_net):
+    net, params, stats, batch = quick_net
+    result = profile_net(net, params, stats, batch, iterations=1)
+    text = format_profile(result)
+    lines = text.splitlines()
+    assert lines[0].split() == ["layer", "forward", "(ms)", "backward", "(ms)"]
+    assert "fused whole-net: forward" in lines[-1]
+    for name in result["layers"]:
+        assert any(line.startswith(name) for line in lines[1:]), name

@@ -1,0 +1,138 @@
+"""Fold a round-span trace into the per-phase table PERF.md cites.
+
+Input: a Chrome trace-event JSON written by ``--trace_out`` /
+``obs.Tracer.save`` (or its sibling ``.jsonl`` structured run log —
+both carry the same spans).  Output: one row per span name with count,
+total/mean/p50/max milliseconds and the share of run wall time, plus an
+instant-event summary (faults, retries, quarantines) and the
+producer/consumer overlap audit — the numbers behind "is round r+1's
+assembly actually hidden under round r's execute?".
+
+    python tools/trace_report.py RUN.trace.json
+    python tools/trace_report.py RUN.trace.jsonl --json   # machine form
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+
+def load_events(path: str) -> List[dict]:
+    """Chrome-JSON or JSONL -> a uniform event list: spans as
+    {name, ts (us), dur (us), tid/thread}, instants as {name, ts}."""
+    if path.endswith(".jsonl"):
+        events = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                ev = {
+                    "name": rec["name"],
+                    "ph": "X" if rec.get("kind") == "span" else "i",
+                    "ts": float(rec.get("ts_s", 0.0)) * 1e6,
+                    "tid": rec.get("thread", "?"),
+                }
+                if rec.get("kind") == "span":
+                    ev["dur"] = float(rec.get("dur_ms", 0.0)) * 1e3
+                events.append(ev)
+        return events
+    with open(path) as f:
+        doc = json.load(f)
+    return doc["traceEvents"] if isinstance(doc, dict) else doc
+
+
+def fold(events: List[dict]) -> Dict[str, object]:
+    spans = [e for e in events if e.get("ph") == "X"]
+    instants = [e for e in events if e.get("ph") == "i"]
+    by_name: Dict[str, List[dict]] = {}
+    for e in spans:
+        by_name.setdefault(e["name"], []).append(e)
+    wall_us = 0.0
+    if spans:
+        t0 = min(e["ts"] for e in spans)
+        t1 = max(e["ts"] + e["dur"] for e in spans)
+        wall_us = max(1e-9, t1 - t0)
+    phases = {}
+    for name, evs in sorted(by_name.items()):
+        durs = sorted(e["dur"] for e in evs)
+        total = sum(durs)
+        phases[name] = {
+            "count": len(durs),
+            "total_ms": round(total / 1e3, 3),
+            "mean_ms": round(total / len(durs) / 1e3, 3),
+            "p50_ms": round(durs[len(durs) // 2] / 1e3, 3),
+            "max_ms": round(durs[-1] / 1e3, 3),
+            "pct_of_wall": round(100.0 * total / wall_us, 1),
+            "threads": sorted({str(e["tid"]) for e in evs}),
+        }
+    inst_counts: Dict[str, int] = {}
+    for e in instants:
+        inst_counts[e["name"]] = inst_counts.get(e["name"], 0) + 1
+    # overlap audit: any producer-thread assemble/h2d span intersecting
+    # a different thread's execute span in time
+    overlap = False
+    execs = by_name.get("execute", [])
+    for a in by_name.get("assemble", []) + by_name.get("h2d", []):
+        for x in execs:
+            if a["tid"] != x["tid"] and (
+                a["ts"] < x["ts"] + x["dur"] and x["ts"] < a["ts"] + a["dur"]
+            ):
+                overlap = True
+                break
+        if overlap:
+            break
+    return {
+        "wall_ms": round(wall_us / 1e3, 3),
+        "phases": phases,
+        "instants": dict(sorted(inst_counts.items())),
+        "producer_overlap_observed": overlap,
+    }
+
+
+def format_report(rep: Dict[str, object]) -> str:
+    lines = [
+        "%-12s %7s %12s %10s %10s %10s %8s"
+        % ("phase", "count", "total (ms)", "mean", "p50", "max", "% wall")
+    ]
+    for name, p in rep["phases"].items():
+        lines.append(
+            "%-12s %7d %12.1f %10.2f %10.2f %10.2f %8.1f"
+            % (
+                name, p["count"], p["total_ms"], p["mean_ms"],
+                p["p50_ms"], p["max_ms"], p["pct_of_wall"],
+            )
+        )
+    lines.append("wall: %.1f ms" % rep["wall_ms"])
+    if rep["instants"]:
+        lines.append(
+            "instants: "
+            + ", ".join(f"{k} x{v}" for k, v in rep["instants"].items())
+        )
+    lines.append(
+        "producer assembly/h2d overlapping consumer execute: %s"
+        % ("YES" if rep["producer_overlap_observed"] else "no")
+    )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("trace", help="Chrome trace .json or run-log .jsonl")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the folded report as JSON")
+    args = ap.parse_args(argv)
+    rep = fold(load_events(args.trace))
+    if args.json:
+        print(json.dumps(rep, indent=2))
+    else:
+        print(format_report(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
