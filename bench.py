@@ -54,6 +54,18 @@ Modes (env):
                         % (<2% acceptance), the measured cost of a
                         disabled span, and the span/overlap audit of
                         the produced trace (OBS_r09.json artifact)
+  BENCH_MODE=health     training-health sentry proof (sparknet_tpu/obs/
+                        health.py): A/Bs the pipelined cifar10_quick
+                        loop with the in-graph numerics audit off vs on
+                        (overhead vs the noise floor), asserts the
+                        audited trajectory is BIT-IDENTICAL to the
+                        unaudited one, then injects a NaN at a seeded
+                        round via the chaos nan_injection fault and
+                        shows the sentry flags that exact round, the
+                        flight-recorder bundle names it (folded by
+                        tools/health_report.py), and the rollback
+                        policy recovers the final loss to within the
+                        chaos loss band (HEALTH_r10.json artifact)
 
 Modes can also be selected as ``python bench.py --mode=serve`` (flag
 wins over the env var); an unknown mode is rejected.
@@ -74,7 +86,10 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
-_MODES = ("train", "hostfeed", "scaling", "serve", "chaos", "pipeline", "obs")
+_MODES = (
+    "train", "hostfeed", "scaling", "serve", "chaos", "pipeline", "obs",
+    "health",
+)
 _MODE = os.environ.get("BENCH_MODE", "train")
 for _i, _a in enumerate(sys.argv[1:], start=1):
     if _a.startswith("--mode="):
@@ -91,7 +106,7 @@ if _MODE not in _MODES:
         "bench.py: unknown mode %r (expected one of %s)"
         % (_MODE, "|".join(_MODES))
     )
-if _MODE in ("scaling", "chaos", "pipeline", "obs"):
+if _MODE in ("scaling", "chaos", "pipeline", "obs", "health"):
     # these modes need >1 device; on a 1-chip host force the virtual CPU
     # mesh (the driver's multichip validation environment).  This must run
     # BEFORE the first backend use (XLA_FLAGS is parsed once per process),
@@ -1251,6 +1266,286 @@ def bench_obs():
     print(json.dumps(out))
 
 
+def bench_health():
+    """Training-health sentry proof (``sparknet_tpu/obs/health.py``).
+
+    Four legs over the same pipelined cifar10_quick loop on the virtual
+    dp mesh (the bench_obs protocol):
+
+    1. **overhead A/B** — audit off vs on (the audit fuses a handful of
+       reductions into the jitted round and adds one small per-round
+       device_get of scalar stats), warmed + best-of-N per leg; on this
+       box the delta sits inside the +/-1-3% round-time noise floor, so
+       the number is disclosed against it, OBS_r09-style.
+    2. **bit-identity** — the audited trajectory's full TrainState must
+       equal the unaudited one EXACTLY (the stats are pure readouts).
+    3. **detection + flight recorder** — the chaos harness's
+       ``nan_injection`` fault poisons EVERY dp worker's batch at a
+       seeded round (so the in-graph single-worker mask cannot absorb
+       it), the sentry under ``rollback`` restores the newest verified
+       snapshot and skips the poisoned window, and the dumped flight
+       bundle — folded by ``tools/health_report.py`` — must name that
+       exact round.
+    4. **recovery** — the rolled-back run's final loss must sit inside
+       the chaos loss band (max(0.25, 0.25*|baseline|)) of a no-fault
+       run of the same shape.
+    """
+    import dataclasses
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from sparknet_tpu import config as cfg, models, obs
+    from sparknet_tpu.data import CifarLoader, RoundFeed
+    from sparknet_tpu.io import checkpoint
+    from sparknet_tpu.obs import flight as flight_mod
+    from sparknet_tpu.obs.health import HealthSentry, make_restore_fn
+    from sparknet_tpu.parallel import (
+        ParameterAveragingTrainer,
+        first_worker,
+        make_mesh,
+        shard_leading,
+    )
+    from sparknet_tpu.runtime import chaos
+    from sparknet_tpu.solver import Solver
+
+    workers = int(os.environ.get("BENCH_WORKERS", "2"))
+    tau = int(os.environ.get("BENCH_TAU", "2"))
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    rounds = int(os.environ.get("BENCH_ROUNDS", "5"))
+    passes = max(1, int(os.environ.get("BENCH_PASSES", "3")))
+    nan_round = int(os.environ.get("BENCH_NAN_ROUND", "4"))
+    chaos_rounds = max(rounds, nan_round + 3)
+
+    workdir = tempfile.mkdtemp(prefix="bench_health_")
+    data_dir = os.path.join(workdir, "data")
+    CifarLoader.write_synthetic(data_dir, num_train=256, num_test=32, seed=10)
+    xs, ys = CifarLoader(data_dir).minibatches(batch, train=True)
+
+    def window(r):
+        n = len(xs)
+        data = np.empty((workers, tau) + xs[0].shape, np.float32)
+        label = np.empty((workers, tau, batch), np.float32)
+        for w in range(workers):
+            for t in range(tau):
+                i = (r * workers * tau + w * tau + t) % n
+                data[w, t] = xs[i]
+                label[w, t] = ys[i]
+        return {"data": data, "label": label}
+
+    netp = cfg.replace_data_layers(
+        models.load_model("cifar10_quick"),
+        [(batch, 3, 32, 32), (batch,)],
+        [(batch, 3, 32, 32), (batch,)],
+    )
+    mesh = make_mesh({"dp": workers}, devices=jax.devices()[:workers])
+
+    def build(audit):
+        solver = Solver(
+            models.load_model_solver("cifar10_quick"), net_param=netp,
+            audit=audit,
+        )
+        return solver, ParameterAveragingTrainer(solver, mesh)
+
+    assembly_s = float(os.environ.get("BENCH_HEALTH_ASSEMBLY_MS", "25")) / 1e3
+
+    def assemble(r, out):
+        time.sleep(assembly_s)  # host-I/O stand-in, identical per leg
+        return window(r)
+
+    def timed_loop(solver, trainer, sentry=None):
+        """Mean round seconds of the apps' pipelined loop; the audited
+        leg runs the full sentry observe (the per-round stats fetch is
+        part of what the A/B measures)."""
+        feed = RoundFeed(assemble, mesh=mesh, num_rounds=rounds + 1)
+        try:
+            state = trainer.init_state(seed=0)
+            out = trainer.round(state, feed.next_round(0))
+            state, losses = out[0], out[1]
+            jax.block_until_ready(losses)  # compile + warm off the clock
+            t0 = time.perf_counter()
+            for r in range(1, rounds + 1):
+                if sentry is not None:
+                    state, losses = sentry.guarded_round(
+                        trainer, state, feed.next_round(r), round_index=r
+                    )
+                else:
+                    state, losses = trainer.round(state, feed.next_round(r))
+                jax.block_until_ready(losses)
+            return (time.perf_counter() - t0) / rounds
+        finally:
+            feed.stop()
+
+    def best_of(solver, trainer, n, audited):
+        sentry = HealthSentry(policy="warn") if audited else None
+        timed_loop(solver, trainer, sentry)  # per-leg steady-state entry
+        return min(timed_loop(solver, trainer, sentry) for _ in range(n))
+
+    # ---- leg 1: overhead A/B (audit off vs on)
+    solver_off, trainer_off = build(False)
+    timed_loop(solver_off, trainer_off)  # whole-path warmup
+    base_s = best_of(solver_off, trainer_off, passes, audited=False)
+    solver_on, trainer_on = build(True)
+    audit_s = best_of(solver_on, trainer_on, passes, audited=True)
+    overhead_pct = (audit_s - base_s) / base_s * 100.0
+
+    # ---- leg 2: bit-identity (serial deterministic feed, fresh states)
+    def trajectory(audit, n_rounds=3):
+        solver, trainer = build(audit)
+        state = trainer.init_state(seed=0)
+        for r in range(n_rounds):
+            out = trainer.round(state, shard_leading(window(r), mesh))
+            state = out[0]
+        return jax.device_get(state)
+
+    ta, tb = trajectory(False), trajectory(True)
+    la = jax.tree_util.tree_leaves(ta)
+    lb = jax.tree_util.tree_leaves(tb)
+    bit_identical = len(la) == len(lb) and all(
+        np.array_equal(np.asarray(a), np.asarray(b)) for a, b in zip(la, lb)
+    )
+
+    # ---- legs 3+4: seeded NaN -> detect -> flight bundle -> rollback
+    # the chaos feed injects the fault; EVERY worker is poisoned so the
+    # in-graph mask cannot absorb it and the rollback policy must fire
+    plan = dataclasses.replace(
+        chaos.FaultPlan.default(),
+        seed=10, workers=workers, rounds=chaos_rounds, tau=tau, batch=batch,
+        storage_faults=(), stall_rounds=(), preempt_round=None,
+        corrupt_newest=False, dead_worker=None,
+        nan_round=nan_round, nan_workers=tuple(range(workers)),
+    )
+
+    def chaos_run(p, sentry=None, snapshot_prefix=None, snapshot_every=2):
+        counters = {
+            "storage_injected": 0, "storage_survived": 0,
+            "stalls_injected": 0, "stalls_survived": 0,
+        }
+        solver, trainer = build(sentry is not None)
+        if sentry is not None and snapshot_prefix is not None:
+            sentry.restore_fn = make_restore_fn(
+                solver, snapshot_prefix, trainer=trainer
+            )
+        feed = chaos._Feed(p, xs, ys, counters, [], mesh)
+        state = trainer.init_state(seed=0)
+        losses = None
+        try:
+            for r in range(p.rounds):
+                batches = feed.next_round(r)
+                if sentry is not None:
+                    state, losses = sentry.guarded_round(
+                        trainer, state, batches, round_index=r
+                    )
+                    if snapshot_prefix and (r + 1) % snapshot_every == 0:
+                        checkpoint.snapshot(
+                            solver,
+                            first_worker(jax.device_get(state)),
+                            snapshot_prefix,
+                        )
+                else:
+                    out = trainer.round(state, batches)
+                    state, losses = out[0], out[1]
+        finally:
+            feed.close()
+        return float(np.mean(np.asarray(jax.device_get(losses))))
+
+    # no-fault baseline of the same shape (the recovery band's anchor)
+    no_fault_loss = chaos_run(plan.no_fault_view())
+
+    bundle_path = os.path.join(workdir, "flight_postmortem.json")
+    recorder = flight_mod.install(flight_mod.FlightRecorder(path=bundle_path))
+    sentry = HealthSentry(
+        policy="rollback", echo=lambda m: print(m, file=sys.stderr)
+    )
+    obs.set_sentry(sentry)
+    try:
+        final_loss = chaos_run(
+            plan, sentry=sentry,
+            snapshot_prefix=os.path.join(workdir, "health_ckpt"),
+        )
+    finally:
+        flight_mod.uninstall(recorder)
+        obs.set_sentry(None)
+
+    detected_round = sentry.last_anomaly_round
+    loss_band = max(0.25, 0.25 * abs(no_fault_loss))
+    loss_band_ok = bool(abs(final_loss - no_fault_loss) <= loss_band)
+
+    # the dumped bundle must fold to a report naming the poisoned round
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_health_report", os.path.join(_REPO, "tools", "health_report.py")
+    )
+    health_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(health_report)
+    rep = health_report.fold(health_report.load_records(bundle_path))
+    bundle = flight_mod.load_bundle(bundle_path)
+
+    print(
+        "health: round %.1f ms unaudited | %.1f ms audited (%+.2f%%) | "
+        "bit-identical %s | NaN seeded r%d detected r%s | rollbacks %d | "
+        "final loss %.4f vs no-fault %.4f (band +/-%.3f: %s) | bundle "
+        "%d events, report first_poisoned_round=%s"
+        % (
+            base_s * 1e3, audit_s * 1e3, overhead_pct, bit_identical,
+            nan_round, detected_round, sentry.rollbacks, final_loss,
+            no_fault_loss, loss_band, "OK" if loss_band_ok else "OUT",
+            len(bundle["events"]), rep["first_poisoned_round"],
+        ),
+        file=sys.stderr,
+    )
+    out = {
+        "metric": "health_audit_overhead_pct",
+        "value": round(overhead_pct, 3),
+        "unit": "% of unaudited round time",
+        # done-bar: <= 1.0, i.e. inside the 2% acceptance budget
+        "vs_baseline": round(overhead_pct / 2.0, 3),
+        "platform": jax.devices()[0].platform,
+        "workers": workers,
+        "tau": tau,
+        "batch": batch,
+        "rounds": rounds,
+        "passes": passes,
+        "baseline_round_ms": round(base_s * 1e3, 2),
+        "audit_round_ms": round(audit_s * 1e3, 2),
+        "overhead_audit_pct": round(overhead_pct, 3),
+        "bit_identical": bit_identical,
+        "policy": "rollback",
+        "nan_seeded_round": nan_round,
+        "nan_detected_round": detected_round,
+        "detection_exact": bool(detected_round == nan_round),
+        "rollbacks": sentry.rollbacks,
+        "final_loss": round(final_loss, 4),
+        "no_fault_final_loss": round(no_fault_loss, 4),
+        "loss_band": round(loss_band, 4),
+        "loss_band_ok": loss_band_ok,
+        "flight_bundle_reason": bundle["reason"],
+        "flight_bundle_events": len(bundle["events"]),
+        "flight_bundle_verdicts": len(bundle["verdicts"]),
+        "report_first_poisoned_round": rep["first_poisoned_round"],
+        "note": "pipelined cifar10_quick loop on the virtual dp mesh. "
+        "Overhead legs are warmed + best-of-N but on this shared 2-core "
+        "box run-to-run drift is +/-1-3% of a ~1s round while the "
+        "audit's true cost is a few fused reductions + one scalar-tree "
+        "device_get per round — the A/B bounds the overhead under "
+        "noise (it can measure negative), and bit_identical is the "
+        "controlled proof the audit changes NOTHING about the "
+        "trajectory.  The detection leg poisons EVERY dp worker's "
+        "batch at the seeded round via the chaos nan_injection fault "
+        "(single-worker poison is absorbed in-graph by the sentry "
+        "mask and never reaches the average — that path is proved by "
+        "the tier-1 chaos smoke), so the rollback policy must restore "
+        "the newest verified snapshot and skip the poisoned window; "
+        "the flight bundle dumped at the rollback is folded by "
+        "tools/health_report.py and must name the seeded round.  On "
+        "the axon relay the sentry's per-round device_get degrades "
+        "the put lane (PERF.md) — --health is opt-in there.",
+    }
+    print(json.dumps(out))
+
+
 def main():
     if _MODE == "scaling":
         bench_scaling()
@@ -1269,6 +1564,9 @@ def main():
         return
     if _MODE == "obs":
         bench_obs()
+        return
+    if _MODE == "health":
+        bench_health()
         return
     # the remote-TPU tunnel occasionally drops a request mid-run; one
     # retry keeps the recorded benchmark from dying on a transient

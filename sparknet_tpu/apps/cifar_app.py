@@ -118,6 +118,12 @@ def main(argv=None) -> int:
     num_test_batches = len(xt)
 
     solver = Solver(models.load_model_solver("cifar10_full"))
+    # --health: numerics audit + divergence sentry.  Built BEFORE the
+    # trainer (the audit arity bakes into the shard_map output spec);
+    # this app keeps no snapshots, so rollback degrades to halt.
+    from sparknet_tpu.obs import health as health_mod
+
+    sentry = health_mod.sentry_from_args(args, solver, echo=log.log)
     trainer = ParameterAveragingTrainer(solver, mesh)
     state = trainer.init_state(seed=args.seed)
     test_batches, test_counts = ParameterAveragingTrainer.pad_partitions(
@@ -156,12 +162,20 @@ def main(argv=None) -> int:
         for r in range(args.rounds):
             if r % args.test_every == 0:  # test before train, CifarApp.scala:101
                 log.log(f"round {r}, accuracy {evaluate(r):.4f}")
-            state, _ = trainer.round(state, feed.next_round(r))
+            if sentry is not None:
+                state, _ = sentry.guarded_round(
+                    trainer, state, feed.next_round(r), round_index=r
+                )
+            else:
+                state, _ = trainer.round(state, feed.next_round(r))
             log.log(
                 f"round {r} trained, smoothed_loss {solver.smoothed_loss:.4f}"
             )
         log.log(f"final accuracy {evaluate():.4f}")
         return 0
+    except health_mod.SentryHalt as e:
+        log.log(f"training halted by the health sentry: {e}")
+        return 1
     finally:
         feed.stop()
         run_obs.close()

@@ -110,6 +110,11 @@ def main(argv=None) -> int:
         {"dp": args.workers}, devices=jax.devices()[: args.workers]
     )
     solver = Solver(models.load_model_solver("cifar10_full"))
+    # --health sentry (before the trainer: audit arity bakes into the
+    # shard_map output spec); no snapshots here -> rollback = halt
+    from sparknet_tpu.obs import health as health_mod
+
+    sentry = health_mod.sentry_from_args(args, solver, echo=log.log)
     trainer = ParameterAveragingTrainer(solver, mesh)
     state = trainer.init_state(seed=args.seed)
     log.log("nets ready")
@@ -137,7 +142,12 @@ def main(argv=None) -> int:
     )
     try:
         for r in range(args.rounds):
-            state, _ = trainer.round(state, feed.next_round(r))
+            if sentry is not None:
+                state, _ = sentry.guarded_round(
+                    trainer, state, feed.next_round(r), round_index=r
+                )
+            else:
+                state, _ = trainer.round(state, feed.next_round(r))
             log.log(
                 f"round {r} trained, smoothed_loss {solver.smoothed_loss:.4f}"
             )
@@ -159,6 +169,9 @@ def main(argv=None) -> int:
         acc = scores.get("accuracy", 0.0) / (args.workers * nb)
         log.log(f"final accuracy {acc:.4f}")
         return 0
+    except health_mod.SentryHalt as e:
+        log.log(f"training halted by the health sentry: {e}")
+        return 1
     finally:
         # telemetry closes AFTER the final-accuracy line so the JSONL
         # run log carries the run's headline result too

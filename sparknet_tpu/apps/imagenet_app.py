@@ -285,6 +285,11 @@ def main(argv=None) -> int:
         test_transform=transforms.test_transform(mean, args.crop),
     )
 
+    # --health sentry (before the trainer: audit arity bakes into the
+    # shard_map output spec); no snapshots here -> rollback = halt
+    from sparknet_tpu.obs import health as health_mod
+
+    sentry = health_mod.sentry_from_args(args, solver, echo=log.log)
     trainer = ParameterAveragingTrainer(solver, mesh)
     state = trainer.init_state(seed=args.seed)
     test_on_dev = shard_leading_global(test_batches, mesh)
@@ -318,7 +323,12 @@ def main(argv=None) -> int:
             if r % args.test_every == 0:  # test-then-train, ImageNetApp.scala:118
                 log.log(f"{evaluate(r) * 100:.2f}% accuracy", i=r)
             log.log("training", i=r)
-            state, _ = trainer.round(state, feed.next_round(r))
+            if sentry is not None:
+                state, _ = sentry.guarded_round(
+                    trainer, state, feed.next_round(r), round_index=r
+                )
+            else:
+                state, _ = trainer.round(state, feed.next_round(r))
             log.log(
                 f"trained, smoothed_loss {solver.smoothed_loss:.4f}", i=r
             )
@@ -327,6 +337,9 @@ def main(argv=None) -> int:
         if jax.process_index() == 0:
             print(f"final accuracy {acc * 100:.2f}%")
         return 0
+    except health_mod.SentryHalt as e:
+        log.log(f"training halted by the health sentry: {e}")
+        return 1
     finally:
         # telemetry closes AFTER the final-accuracy line so the JSONL
         # run log carries the run's headline result too

@@ -29,18 +29,9 @@ TAU = 50  # syncInterval, ImageNetRunDBApp.scala:104
 def _broadcast_state(trainer, st):
     """Restore semantics: every worker restarts from the snapshot file,
     exactly like the reference restoring the same .solverstate on each
-    executor."""
-    import jax
-    from sparknet_tpu.parallel import shard_leading
-
-    n = trainer.num_workers
-    stacked = jax.tree_util.tree_map(
-        lambda x: np.broadcast_to(
-            np.asarray(x), (n,) + np.asarray(x).shape
-        ).copy(),
-        jax.device_get(st),
-    )
-    return shard_leading(stacked, trainer.mesh)
+    executor (now shared trainer machinery — the sentry's rollback path
+    uses the same re-placement)."""
+    return trainer.broadcast_state(st)
 
 
 def main(argv=None) -> int:
@@ -141,11 +132,21 @@ def main(argv=None) -> int:
         [(int(info["test_batch"]), 3, crop, crop), (int(info["test_batch"]),)],
     )
     solver = Solver(models.load_model_solver(args.model), net_param=netp)
+    # --health sentry (before the trainer: audit arity bakes into the
+    # shard_map output spec); rollback restores through this app's own
+    # snapshot prefix below
+    from sparknet_tpu.obs import health as health_mod
+
+    sentry = health_mod.sentry_from_args(args, solver, echo=log.log)
     mesh = make_mesh({"dp": n_workers}, devices=jax.devices()[:n_workers])
     trainer = ParameterAveragingTrainer(solver, mesh)
     state = trainer.init_state(seed=args.seed)
 
     prefix = args.snapshot_prefix or os.path.join(args.db_dir, "imagenet_db")
+    if sentry is not None:
+        sentry.restore_fn = health_mod.make_restore_fn(
+            solver, prefix, trainer=trainer
+        )
     start_round = 0
     if args.resume:
         # fault-tolerant resume: CRC-verified, newest-valid-wins — a
@@ -222,7 +223,12 @@ def main(argv=None) -> int:
             if r % args.test_every == 0:
                 log.log(f"{evaluate() * 100:.2f}% accuracy", i=r)
             log.log("training", i=r)
-            state, _ = trainer.round(state, feed.next_round(r))
+            if sentry is not None:
+                state, _ = sentry.guarded_round(
+                    trainer, state, feed.next_round(r), round_index=r
+                )
+            else:
+                state, _ = trainer.round(state, feed.next_round(r))
             log.log(f"trained, smoothed_loss {solver.smoothed_loss:.4f}", i=r)
             if args.snapshot_every and (r + 1) % args.snapshot_every == 0:
                 st = first_worker(jax.device_get(state))
@@ -235,6 +241,11 @@ def main(argv=None) -> int:
         log.log(f"final accuracy {acc * 100:.2f}%")
         print(f"final accuracy {acc * 100:.2f}%")
         return 0
+    except health_mod.SentryHalt as e:
+        # no snapshot of the condemned weights; the newest snapshot on
+        # disk predates the anomaly and stays the restore point
+        log.log(f"training halted by the health sentry: {e}")
+        return 1
     finally:
         # telemetry closes AFTER the final-accuracy line so the JSONL
         # run log carries the run's headline result too

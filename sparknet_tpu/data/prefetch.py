@@ -144,6 +144,11 @@ class Prefetcher:
                     tm.feed_stalls.inc()
                 obs.instant("prefetch_stall", cat="fault", msg=msg)
                 obs.report_unhealthy("prefetch_stall: " + msg)
+                # a stall is a postmortem moment: dump the flight ring
+                # (no-op unless --flight_recorder armed one)
+                obs.flight.dump_if_active(
+                    "prefetch_stall", extra={"msg": msg}
+                )
                 raise PrefetchStall(msg) from None
         if item is None:
             self._done = True  # sticky: keep raising after exhaustion/error

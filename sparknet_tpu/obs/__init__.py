@@ -9,13 +9,25 @@ serving (ARCHITECTURE.md "Observability"):
   Chrome trace-event JSON (Perfetto-loadable, thread-correct) plus a
   structured JSONL run log.
 - ``obs.exporter`` — the opt-in ``/metrics`` + ``/healthz`` HTTP
-  sidecar every ``cli train``/app run gets via ``--obs``.
+  sidecar every ``cli train``/app run gets via ``--obs`` (also exports
+  the divergence sentry's state: ``last_anomaly_round``, policy, 503
+  while halted).
+- ``obs.health``   — the training-health sentry: in-graph numerics
+  audit (grad norm, update/param ratios, non-finite counts, fused into
+  the jitted step), in-graph poisoned-worker masking, and the
+  warn/halt/rollback divergence policy (``--health``).
+- ``obs.flight``   — the crash flight recorder: a bounded ring of
+  recent spans/verdicts/samples dumped as one postmortem JSON bundle
+  on crash/SIGTERM/stall/halt/chaos fault (``--flight_recorder``;
+  folded by ``tools/health_report.py``).
 
 Instrumented code calls the module-level hooks (``obs.span``,
 ``obs.instant``, ``obs.training_metrics()``, ``obs.fault``), which are
 near-free no-ops until ``obs.start(...)`` — wired to ``--obs`` /
-``--trace_out`` flags by ``add_cli_args``/``start_from_args`` — turns
-them on.
+``--trace_out`` / ``--flight_recorder`` flags by
+``add_cli_args``/``start_from_args`` — turns them on.  (``obs.health``
+is imported on demand — it pulls jax; the rest of the package stays
+import-light for CLI startup.)
 """
 
 from __future__ import annotations
@@ -26,7 +38,9 @@ import weakref
 from collections import deque
 from typing import Optional
 
+from sparknet_tpu.obs import flight  # noqa: F401
 from sparknet_tpu.obs.exporter import JsonHTTPHandler, ObsExporter  # noqa: F401
+from sparknet_tpu.obs.flight import FlightRecorder  # noqa: F401
 from sparknet_tpu.obs.metrics import (  # noqa: F401
     LATENCY_BUCKETS_S,
     Counter,
@@ -166,11 +180,41 @@ class TrainingMetrics:
             "sparknet_host_rss_bytes", "peak resident set size",
             fn=_host_rss_bytes,
         )
+        # training-health series (obs/health.py numerics audit) — zero
+        # until a run enables the audit (--health)
+        self.grad_norm = registry.gauge(
+            "sparknet_grad_norm",
+            "global L2 norm of the last audited iteration's raw "
+            "gradients (pre-clip)",
+        )
+        self.nonfinite = registry.counter(
+            "sparknet_nonfinite_total",
+            "non-finite values seen by the numerics audit "
+            "(grads + params + loss)",
+        )
+        self.update_ratio = registry.gauge(
+            "sparknet_update_ratio",
+            "per-param-group update/param L2 ratio of the last audited "
+            "iteration",
+            labels=("group",),
+        )
+        self.health_anomalies = registry.counter(
+            "sparknet_health_anomalies_total",
+            "divergence-sentry anomaly verdicts, by kind",
+            labels=("kind",),
+        )
+        self.health_rollbacks = registry.counter(
+            "sparknet_health_rollbacks_total",
+            "sentry-triggered rollbacks to a verified snapshot",
+        )
 
 
 _lock = threading.Lock()
 _training: Optional[TrainingMetrics] = None
 _unhealthy_reason: Optional[str] = None
+# the active divergence sentry (obs/health.py) — /healthz exports its
+# state so an orchestrator can tell "stalled" from "diverged"
+_sentry = None
 
 
 def enable_training_metrics() -> TrainingMetrics:
@@ -197,21 +241,41 @@ def _reset_training_metrics_for_tests() -> None:
     """Drop the process singleton so a test gets fresh counters; NOT
     for production code (instrumented sites cache nothing, so the swap
     is safe mid-process)."""
-    global _training, _unhealthy_reason
+    global _training, _unhealthy_reason, _sentry
     with _lock:
         _training = None
         _unhealthy_reason = None
+        _sentry = None
         set_phase_observer(None)
+    flight.uninstall()
+
+
+def set_sentry(sentry) -> None:
+    """Register the run's HealthSentry (None clears).  /healthz and
+    flight bundles read its ``state_dict()``."""
+    global _sentry
+    _sentry = sentry
+
+
+def sentry_state() -> Optional[dict]:
+    """The active sentry's exported state, or None when no sentry."""
+    s = _sentry
+    if s is None:
+        return None
+    return s.state_dict()
 
 
 def fault(kind: str, **args) -> None:
     """Tag a fault: an instant event on the trace (so fault ->
     recovery latency is readable off the timeline) + the per-kind
-    counter when metrics are on."""
+    counter when metrics are on + a flight-recorder postmortem dump
+    when one is installed (faults are exactly the moments whose recent
+    history a postmortem wants)."""
     instant(f"fault_{kind}", cat="fault", **args)
     tm = _training
     if tm is not None:
         tm.faults.labels(kind).inc()
+    flight.dump_if_active(f"fault_{kind}", extra=args or None)
 
 
 def report_unhealthy(reason: str) -> None:
@@ -250,6 +314,28 @@ def add_cli_args(parser) -> None:
         help="write a Chrome trace (load in Perfetto: ui.perfetto.dev) "
         "of round phases to this path, plus a .jsonl structured run log",
     )
+    parser.add_argument(
+        "--health", nargs="?", const="warn", default=None,
+        choices=["warn", "halt", "rollback"], metavar="POLICY",
+        help="enable the in-graph numerics audit + divergence sentry "
+        "(warn|halt|rollback; bare --health = warn).  rollback restores "
+        "the newest verified snapshot and skips the poisoned window "
+        "(needs snapshot machinery; loops without it degrade to halt)",
+    )
+    parser.add_argument(
+        "--health_policy", default=None,
+        choices=["warn", "halt", "rollback"],
+        help="sentry policy (overrides --health's value)",
+    )
+    parser.add_argument(
+        "--flight_recorder", nargs="?",
+        const=flight.DEFAULT_BUNDLE_PATH, default=None,
+        metavar="BUNDLE.json",
+        help="keep a bounded in-memory ring of recent spans/metric "
+        "samples/health verdicts and dump it as a postmortem JSON "
+        "bundle on crash, SIGTERM, feed stall, sentry halt, or chaos "
+        "fault (fold it with tools/health_report.py)",
+    )
 
 
 class ObsRun:
@@ -266,11 +352,13 @@ class ObsRun:
     microseconds per round (measured in ``OBS_r09.json``)."""
 
     def __init__(self, exporter=None, tracer=None, trace_out=None,
-                 metrics: Optional[TrainingMetrics] = None):
+                 metrics: Optional[TrainingMetrics] = None,
+                 recorder: Optional[FlightRecorder] = None):
         self.exporter = exporter
         self.tracer = tracer
         self.trace_out = trace_out
         self.metrics = metrics
+        self.recorder = recorder
         self._closed = False
 
     @property
@@ -289,6 +377,14 @@ class ObsRun:
             if self.trace_out:
                 self.tracer.save(self.trace_out)
             self.tracer.close()
+        if self.recorder is not None:
+            # clean close: detach WITHOUT dumping (bundles are
+            # postmortems; any already-dumped one stays on disk)
+            flight.uninstall(self.recorder)
+        # the run's divergence sentry is scoped to the run as well: a
+        # later run in this process must not inherit a halted /healthz
+        # or embed this run's verdicts in its flight bundles
+        set_sentry(None)
 
 
 def start(
@@ -296,15 +392,24 @@ def start(
     port: int = DEFAULT_OBS_PORT,
     host: str = "127.0.0.1",
     trace_out: Optional[str] = None,
+    flight_out: Optional[str] = None,
     echo=print,
 ) -> ObsRun:
     """Turn telemetry on for this run: ``metrics=True`` starts the
-    /metrics + /healthz sidecar; ``trace_out`` installs the tracer.
-    Either switch also enables the training metric series (spans feed
+    /metrics + /healthz sidecar; ``trace_out`` installs the tracer;
+    ``flight_out`` installs the crash flight recorder (bundle path).
+    metrics/trace also enable the training metric series (spans feed
     the per-phase histogram).  Returns an ``ObsRun`` to ``close()`` in
     the run's ``finally``."""
-    if not metrics and not trace_out:
+    if not metrics and not trace_out and not flight_out:
         return ObsRun()
+    recorder = None
+    if flight_out:
+        recorder = flight.install(FlightRecorder(path=flight_out))
+        if echo is not None:
+            echo(f"obs: flight recorder armed -> {flight_out}")
+    if not metrics and not trace_out:
+        return ObsRun(recorder=recorder)
     tm = enable_training_metrics()
     exporter = None
     if metrics:
@@ -322,7 +427,7 @@ def start(
                 f"obs: tracing round phases -> {trace_out} "
                 f"(+ {jsonl_path_for(trace_out)})"
             )
-    return ObsRun(exporter, tracer, trace_out, tm)
+    return ObsRun(exporter, tracer, trace_out, tm, recorder)
 
 
 def start_from_args(args, echo=print) -> ObsRun:
@@ -330,5 +435,6 @@ def start_from_args(args, echo=print) -> ObsRun:
         metrics=getattr(args, "obs", False),
         port=getattr(args, "obs_port", DEFAULT_OBS_PORT),
         trace_out=getattr(args, "trace_out", None),
+        flight_out=getattr(args, "flight_recorder", None),
         echo=echo,
     )

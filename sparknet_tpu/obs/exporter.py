@@ -68,10 +68,25 @@ class _ObsHandler(JsonHTTPHandler):
             )
         elif self.path == "/healthz":
             reason = ex.health_fn() if ex.health_fn is not None else None
+            # divergence-sentry state rides along so an orchestrator can
+            # tell "training stalled" (feed wedged -> reason set) from
+            # "training diverged" (sentry halted -> 503 + sentry block)
+            from sparknet_tpu import obs as _obs
+
+            sentry = _obs.sentry_state()
+            payload = {}
+            if sentry is not None:
+                payload["sentry"] = sentry
+                if sentry.get("halted"):
+                    reason = reason or (
+                        "sentry_halt: " + str(sentry.get("halt_reason"))
+                    )
             if reason:
-                self._send_json(503, {"status": "unhealthy", "reason": reason})
+                payload.update({"status": "unhealthy", "reason": reason})
+                self._send_json(503, payload)
             else:
-                self._send_json(200, {"status": "ok"})
+                payload["status"] = "ok"
+                self._send_json(200, payload)
         else:
             self._send_json(404, {"error": f"no route {self.path}"})
 

@@ -166,6 +166,9 @@ _tracer: Optional[Tracer] = None
 # observes (name, dur_s) of phase-cat spans into the metrics layer when
 # training metrics are enabled (set by obs/__init__; None = off)
 _phase_observer = None
+# the installed FlightRecorder's event ring (obs/flight.py; None = off)
+# — spans/instants feed it even when no Tracer is recording
+_flight = None
 
 
 def install_tracer(tracer: Tracer) -> Tracer:
@@ -187,6 +190,13 @@ def get_tracer() -> Optional[Tracer]:
 def set_phase_observer(fn) -> None:
     global _phase_observer
     _phase_observer = fn
+
+
+def set_flight(recorder) -> None:
+    """Point span()/instant() at a flight-recorder ring (obs/flight.py
+    owns the install/uninstall lifecycle)."""
+    global _flight
+    _flight = recorder
 
 
 class _NullSpan:
@@ -223,6 +233,17 @@ class _Span:
                 self.name, self.cat,
                 (self._t0 - t._t0) * 1e6, dur_s * 1e6, self.args,
             )
+        f = _flight
+        if f is not None:
+            rec = {
+                "kind": "span", "name": self.name, "cat": self.cat,
+                "t_s": round(time.time(), 3),
+                "dur_ms": round(dur_s * 1e3, 4),
+                "thread": threading.current_thread().name,
+            }
+            if self.args:
+                rec["args"] = self.args
+            f.record_event(rec)
         obs = _phase_observer
         if obs is not None and self.cat == "phase":
             obs(self.name, dur_s)
@@ -232,17 +253,29 @@ class _Span:
 def span(name: str, cat: str = "phase", **args):
     """Context manager timing one phase of work.  ``cat="phase"`` spans
     also feed the per-phase latency histogram when training metrics are
-    enabled.  Near-free when tracing AND metrics are off."""
-    if _tracer is None and _phase_observer is None:
+    enabled.  Near-free when tracing, metrics AND flight recording are
+    off."""
+    if _tracer is None and _phase_observer is None and _flight is None:
         return _NULL_SPAN
     return _Span(name, cat, args or None)
 
 
 def instant(name: str, cat: str = "event", **args) -> None:
-    """Record a tagged point event (no-op when tracing is off)."""
+    """Record a tagged point event (no-op when tracing and flight
+    recording are off)."""
     t = _tracer
     if t is not None:
         t.instant(name, cat, args or None)
+    f = _flight
+    if f is not None:
+        rec = {
+            "kind": "instant", "name": name, "cat": cat,
+            "t_s": round(time.time(), 3),
+            "thread": threading.current_thread().name,
+        }
+        if args:
+            rec["args"] = args
+        f.record_event(rec)
 
 
 def jsonl_path_for(trace_out: str) -> str:

@@ -144,15 +144,34 @@ class ParameterAveragingTrainer:
         axis: str = "dp",
         average_stats: bool = True,
         average_params: bool = True,
+        mask_nonfinite: bool = True,
     ):
         """``average_params=False`` skips the cross-worker pmean — a
         DIAGNOSTIC mode (workers then train fully independently): the
         scaling bench A/Bs it against the real round to attribute round
-        time to compute vs collective."""
+        time to compute vs collective.
+
+        With the solver's numerics audit on (``solver.audit`` — set it
+        BEFORE constructing the trainer; the audit arity is baked into
+        the shard_map output spec), ``round`` returns a third value:
+        the per-worker audit stats tree.  ``mask_nonfinite`` then also
+        arms the IN-GRAPH sentry mask: a worker whose local window
+        produced any non-finite grad/param is excluded from this
+        round's average before the ``psum`` — the poison never reaches
+        the survivors, and the masked slot is overwritten with the
+        survivor mean (it rejoins healthy next round).  If NO worker is
+        finite the round keeps each worker's own (poisoned) params so
+        the host sentry sees the damage and escalates, instead of a
+        silent all-zero average."""
         self.solver = solver
         self.mesh = mesh
         self.axis = axis
         self.num_workers = mesh.shape[axis]
+        self.audit = bool(getattr(solver, "audit", False))
+        self.mask_nonfinite = bool(mask_nonfinite) and self.audit
+
+        audit = self.audit
+        mask_nf = self.mask_nonfinite
 
         def round_body(state, batches, rng, live):
             # shard_map hands each worker a leading axis of size 1
@@ -160,7 +179,11 @@ class ParameterAveragingTrainer:
             bt = tree_map(lambda x: x[0], batches)
             widx = jax.lax.axis_index(axis)
             lrng = jax.random.fold_in(rng, widx)
-            st, losses = solver._step_tau(st, bt, lrng)
+            st, out = solver._step_tau(st, bt, lrng)
+            if audit:
+                losses, astats = out
+            else:
+                losses = out
             # averaging round: params (and BN stats) only, never history.
             # Survivor-aware: the average is a masked weighted mean over
             # LIVE workers — psum(where(live, theta, 0))/psum(live) — so
@@ -172,11 +195,28 @@ class ParameterAveragingTrainer:
             # leak through 0*NaN=NaN into the psum.  With live == ones
             # this is exactly psum(theta)/N, the original pmean.
             alive = live[0]
-            denom = jnp.maximum(jax.lax.psum(alive, axis), 1.0)
+            if mask_nf:
+                # in-graph sentry mask: this worker's window produced a
+                # non-finite grad or param -> drop it from the average
+                bad = (
+                    jnp.sum(astats["nonfinite_grads"])
+                    + jnp.sum(astats["nonfinite_params"])
+                ) > 0
+                ok = jnp.where(bad, 0.0, 1.0)
+                alive = alive * ok
+                astats = dict(astats, masked=1.0 - ok)
+            denom0 = jax.lax.psum(alive, axis)
+            denom = jnp.maximum(denom0, 1.0)
 
             def wmean(w):
                 contrib = jnp.where(alive > 0, w, jnp.zeros_like(w))
-                return jax.lax.psum(contrib, axis) / denom.astype(w.dtype)
+                m = jax.lax.psum(contrib, axis) / denom.astype(w.dtype)
+                if mask_nf:
+                    # no finite worker at all: keep own params (the
+                    # host sentry escalates) instead of an all-zero
+                    # "average" that would read as healthy
+                    return jnp.where(denom0 > 0, m, w)
+                return m
 
             avg_params = (
                 tree_map(wmean, st.params) if average_params else st.params
@@ -186,7 +226,27 @@ class ParameterAveragingTrainer:
                 if average_stats and average_params
                 else st.stats
             )
-            st = TrainState(avg_params, avg_stats, st.history, st.iter)
+            history = st.history
+            if mask_nf and average_params:
+                # the masked slot's params are replaced by the survivor
+                # mean, but its momentum history still holds the
+                # poisoned window — zero it too, or momentum replays the
+                # non-finite update next round and the worker re-
+                # diverges (staying masked forever off one bad batch).
+                # bad=False selects the original leaves exactly, so
+                # healthy rounds keep the bit-identity contract.
+                rejoined = jnp.logical_and(bad, denom0 > 0)
+                history = tree_map(
+                    lambda h: jnp.where(rejoined, jnp.zeros_like(h), h),
+                    history,
+                )
+            st = TrainState(avg_params, avg_stats, history, st.iter)
+            if audit:
+                return (
+                    tree_map(lambda x: x[None], st),
+                    losses[None],
+                    tree_map(lambda x: x[None], astats),
+                )
             return tree_map(lambda x: x[None], st), losses[None]
 
         # state AND batches are donated: the consumed round's batch
@@ -198,12 +258,15 @@ class ParameterAveragingTrainer:
         # device buffer and donates THAT) or a freshly-placed device
         # batch per round (the apps/RoundFeed pattern); a device batch
         # is deleted by the round that consumes it.
+        out_specs = (
+            (P(axis), P(axis), P(axis)) if audit else (P(axis), P(axis))
+        )
         self._round = jax.jit(
             shard_map(
                 round_body,
                 mesh=mesh,
                 in_specs=(P(axis), P(axis), P(), P(axis)),
-                out_specs=(P(axis), P(axis)),
+                out_specs=out_specs,
             ),
             donate_argnums=(0, 1),
         )
@@ -260,6 +323,30 @@ class ParameterAveragingTrainer:
 
         return tree_map(mk, st)
 
+    def broadcast_state(self, st: TrainState) -> TrainState:
+        """Re-place a SINGLE-replica TrainState (a snapshot restore)
+        onto the mesh: every worker slot gets the same value — the
+        reference's restore-on-every-executor semantics.  The resume
+        entry for ``imagenet_run_db_app --resume``, the chaos harness,
+        and the sentry's rollback path."""
+        n = self.num_workers
+        stacked = tree_map(
+            lambda x: np.broadcast_to(
+                np.asarray(x), (n,) + np.asarray(x).shape
+            ).copy(),
+            jax.device_get(st),
+        )
+        if jax.process_count() == 1:
+            return shard_leading(stacked, self.mesh, self.axis)
+        return shard_leading_global(
+            tree_map(
+                lambda x: x[local_worker_slice(self.mesh, self.axis)],
+                stacked,
+            ),
+            self.mesh,
+            self.axis,
+        )
+
     def _place_live(self, live_mask) -> jax.Array:
         """Place a host (num_workers,) 0/1 mask over the dp axis.
         Cached per distinct mask value — the loops pass the same mask
@@ -301,18 +388,29 @@ class ParameterAveragingTrainer:
         survive this round: dead workers are excluded from the average
         (masked weighted mean) and receive the survivor mean — a lost
         partition degrades throughput, never the weights.  ``None``
-        means all alive (identical numerics to the unmasked round)."""
+        means all alive (identical numerics to the unmasked round).
+
+        With the solver's numerics audit on, returns ``(state, losses,
+        stats)`` where ``stats`` is the per-worker audit tree (leaves
+        (num_workers, tau); plus ``masked`` (num_workers,) when the
+        in-graph non-finite mask is armed)."""
         rng = rng if rng is not None else train_key(0)
         # "average" is the whole averaging round (this method IS one
         # round of the SparkNet algorithm); "execute" nests inside it as
         # the fused XLA program's dispatch/execution.  Span timing stays
         # dispatch-honest: no extra device sync is added here.
+        astats = None
         with obs.span("average"):
             if live_mask is None:
                 live_mask = np.ones((self.num_workers,), np.float32)
             live = self._place_live(live_mask)  # cached per mask value
             with obs.span("execute"):
-                state, losses = self._round(state, batches, rng, live)
+                if self.audit:
+                    state, losses, astats = self._round(
+                        state, batches, rng, live
+                    )
+                else:
+                    state, losses = self._round(state, batches, rng, live)
             # recorded lazily: smoothed_loss pulls the worker-mean of the
             # addressable shards on read (Solver._drain_losses) — no
             # device->host sync in the round loop
@@ -322,6 +420,8 @@ class ParameterAveragingTrainer:
             tm.rounds.inc()
             tm.iters.inc(losses.shape[-1])  # tau (shape read: no sync)
         obs.report_healthy()  # a completed round clears /healthz
+        if self.audit:
+            return state, losses, astats
         return state, losses
 
     def test_and_store_result(
@@ -458,15 +558,26 @@ class AllReduceTrainer:
 
     def step(self, state: TrainState, batches: Dict[str, jax.Array], rng=None):
         """tau synchronous steps on a globally-sharded batch
-        (batches[blob]: (tau, global_B, ...))."""
+        (batches[blob]: (tau, global_B, ...)).  With the solver's
+        numerics audit on (readable here at step time — the jit's
+        output sharding is a pytree prefix, so no rebuild is needed),
+        returns ``(state, losses, stats)``."""
         rng = rng if rng is not None else train_key(0)
+        audit = bool(getattr(self.solver, "audit", False))
+        stats = None
         with obs.span("execute"):
             batches = jax.device_put(batches, self._batch_sharding)
-            state, losses = self._jit_round(state, batches, rng)
+            state, out = self._jit_round(state, batches, rng)
+            if audit:
+                losses, stats = out
+            else:
+                losses = out
             self.solver.note_losses(losses)
         tm = obs.training_metrics()
         if tm is not None:
             tm.rounds.inc()
             tm.iters.inc(losses.shape[0])  # tau (shape read: no sync)
         obs.report_healthy()
+        if audit:
+            return state, losses, stats
         return state, losses

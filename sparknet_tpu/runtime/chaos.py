@@ -20,6 +20,10 @@ real TPU pod into a small cifar10_quick run on the virtual mesh —
 - **worker death**: one dp worker drops out mid-run; survivor-aware
   averaging (``ParameterAveragingTrainer.round(live_mask=...)``) keeps
   the weights healthy.
+- **nan injection**: one dp worker's batch is poisoned with NaN at a
+  seeded round; the numerics audit (``obs/health.py``) must flag that
+  EXACT round and the in-graph sentry mask must exclude the poisoned
+  replica from the parameter average before it reaches the ``psum``.
 
 Every fault is counted as injected and (when the run recovers) survived;
 ``bench.py --mode=chaos`` emits the ``CHAOS_r07.json`` artifact
@@ -77,6 +81,13 @@ class FaultPlan:
     dead_worker: Optional[int] = 2
     dead_from_round: int = 4
     snapshot_every: int = 2  # periodic snapshot cadence, in rounds
+    # nan_injection: poison these dp workers' batches with NaN at this
+    # round (fires once, by absolute round index).  The numerics audit +
+    # in-graph sentry mask (obs/health.py) must catch the poisoned
+    # worker(s) BEFORE the parameter average — the divergence-sentry
+    # analog of the dead-worker fault.
+    nan_round: Optional[int] = 2
+    nan_workers: Tuple[int, ...] = (1,)
 
     @classmethod
     def default(cls) -> "FaultPlan":
@@ -91,6 +102,7 @@ class FaultPlan:
             preempt_round=None,
             corrupt_newest=False,
             dead_worker=None,
+            nan_round=None,
         )
 
 
@@ -161,8 +173,13 @@ class _Feed:
         fault_state = fault_state if fault_state is not None else {}
         fault_state.setdefault("faults", {r: n for r, n in plan.storage_faults})
         fault_state.setdefault("stalls", set(plan.stall_rounds))
+        fault_state.setdefault(
+            "nans",
+            set() if plan.nan_round is None else {plan.nan_round},
+        )
         self._faults = fault_state["faults"]
         self._stalls = fault_state["stalls"]
+        self._nans = fault_state["nans"]
         self._rf = None
         self._policy = _retry.RetryPolicy(
             max_attempts=6, base_s=0.005, cap_s=0.02, budget_s=2.0
@@ -178,6 +195,24 @@ class _Feed:
                 i = (r * W * tau + w * tau + t) % n
                 data[w, t] = self.xs[i]
                 label[w, t] = self.ys[i]
+        if r in self._nans:
+            # poison the planned workers' batches with NaN — the
+            # diverging-worker fault the numerics audit must catch
+            # before the parameter average (fires once per plan)
+            self._nans.discard(r)
+            for w in self.plan.nan_workers:
+                data[w] = np.nan
+            self.counters["nan_injected"] = (
+                self.counters.get("nan_injected", 0) + 1
+            )
+            self.events.append(
+                "round %d: NaN injected into worker(s) %s batch"
+                % (r, list(self.plan.nan_workers))
+            )
+            _obs.fault(
+                "nan_injection", round=r,
+                workers=list(self.plan.nan_workers),
+            )
         return {"data": data, "label": label}
 
     def _produce_round(self, r: int):
@@ -290,7 +325,6 @@ def run_chaos(
         ParameterAveragingTrainer,
         first_worker,
         make_mesh,
-        shard_leading,
     )
     from sparknet_tpu.solver import Solver
     from sparknet_tpu.utils.signals import SignalHandler, SolverAction
@@ -325,23 +359,27 @@ def run_chaos(
         [(plan.batch, 3, 32, 32), (plan.batch,)],
         [(plan.batch, 3, 32, 32), (plan.batch,)],
     )
+    # nan_injection exercises the numerics audit + in-graph sentry mask
+    # (obs/health.py): the solver computes the audit stats tree inside
+    # the jitted round, and the host sentry verifies the poisoned round
+    # was flagged at EXACTLY the seeded index
+    audit = plan.nan_round is not None
     solver = Solver(
-        models.load_model_solver("cifar10_quick"), net_param=netp
+        models.load_model_solver("cifar10_quick"), net_param=netp,
+        audit=audit,
     )
     mesh = make_mesh(
         {"dp": plan.workers}, devices=jax.devices()[: plan.workers]
     )
     trainer = ParameterAveragingTrainer(solver, mesh)
+    sentry = None
+    if audit:
+        from sparknet_tpu.obs.health import HealthSentry
+
+        sentry = HealthSentry(policy="warn", echo=note)
 
     def broadcast(st):
-        n = trainer.num_workers
-        stacked = jax.tree_util.tree_map(
-            lambda x: np.broadcast_to(
-                np.asarray(x), (n,) + np.asarray(x).shape
-            ).copy(),
-            jax.device_get(st),
-        )
-        return shard_leading(stacked, mesh)
+        return trainer.broadcast_state(st)
 
     def final_round_loss(losses) -> float:
         return float(np.mean(np.asarray(jax.device_get(losses))))
@@ -356,7 +394,8 @@ def run_chaos(
     state = trainer.init_state(seed=plan.seed)
     losses = None
     for r in range(plan.rounds):
-        state, losses = trainer.round(state, feed.next_round(r))
+        out = trainer.round(state, feed.next_round(r))
+        state, losses = out[0], out[1]  # audit runs drop the stats here
     feed.close()
     baseline_loss = final_round_loss(losses)
     note(f"baseline (no faults): final-round loss {baseline_loss:.4f}")
@@ -403,7 +442,29 @@ def run_chaos(
                 f"round {r}: dp worker {plan.dead_worker} died; "
                 "averaging over survivors"
             )
-        state, losses = trainer.round(state, batches, live_mask=mask)
+        out = trainer.round(state, batches, live_mask=mask)
+        state, losses = out[0], out[1]
+        if sentry is not None:
+            verdict = sentry.observe(r, losses, out[2])
+            if verdict.nonfinite_total > 0:
+                counters.setdefault("nan_detected_round", r)
+            if r == plan.nan_round and counters.get("nan_injected"):
+                # survived = flagged at EXACTLY the seeded round, the
+                # poisoned worker(s) masked out of the average in-graph,
+                # and the surviving weights stayed finite
+                exact = (
+                    verdict.nonfinite_total > 0
+                    and verdict.masked_workers
+                    == sorted(plan.nan_workers)
+                    and sentry.last_anomaly_round == plan.nan_round
+                )
+                if exact:
+                    counters["nan_survived"] = 1
+                    note(
+                        f"round {r}: sentry flagged + masked poisoned "
+                        f"worker(s) {verdict.masked_workers}; average "
+                        "stayed healthy"
+                    )
 
     t_preempt = None
     with SignalHandler(
@@ -506,6 +567,7 @@ def run_chaos(
             "corruption_injected", "corruption_survived",
         ),
         "dead_worker": ("dead_worker_injected", "dead_worker_survived"),
+        "nan_injection": ("nan_injected", "nan_survived"),
     }
     faults = {
         kind: {
@@ -526,6 +588,8 @@ def run_chaos(
         "faults_survived": survived,
         "faults": faults,
         "watchdog_fires": int(counters.get("watchdog_fires", 0)),
+        "nan_round": plan.nan_round,
+        "nan_detected_round": counters.get("nan_detected_round"),
         "recovery_latency_s": (
             round(recovery_latency_s, 3)
             if recovery_latency_s is not None

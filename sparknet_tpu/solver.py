@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sparknet_tpu import obs
+from sparknet_tpu.obs import health as _health
 from sparknet_tpu.config import load_net_prototxt
 from sparknet_tpu.config.schema import NetParameter, SolverParameter, solver_method
 from sparknet_tpu.net import JaxNet, Params, Stats
@@ -167,6 +168,7 @@ class Solver:
         compute_dtype: Optional[str] = None,
         train_transform=None,
         test_transform=None,
+        audit: bool = False,
     ):
         # Per-phase preprocessing closures traced into the jitted step —
         # the reference's imageNetTrain/TestPreprocessing host closures
@@ -174,6 +176,15 @@ class Solver:
         # (batch, rng) -> batch; test_transform: (batch) -> batch.
         self.train_transform = train_transform
         self.test_transform = test_transform
+        # in-graph numerics audit (obs/health.py): when True, the step
+        # additionally returns a small per-iteration stats tree (grad
+        # norm, per-group param/update norms, non-finite counts) FUSED
+        # into the same jitted program — ``step`` then returns
+        # ``(state, losses, stats)``.  Pure readouts: the trajectory is
+        # bit-identical audit on/off (tests/test_health.py).  May be
+        # flipped after construction but BEFORE the first step (the jit
+        # traces lazily).
+        self.audit = bool(audit)
         self.param = param
         self.compute_dtype = compute_dtype
         self.method = solver_method(param)
@@ -258,11 +269,17 @@ class Solver:
 
     def _apply_update(self, params, history, grads, it):
         p = self.param
-        # ClipGradients on raw accumulated grads (sgd_solver.cpp:84-100)
-        if p.clip_gradients > 0:
+        # the raw-grad global L2: ClipGradients' reduction
+        # (sgd_solver.cpp:84-100), computed ONCE and shared with the
+        # numerics audit (obs/health.py) when that is on
+        grad_norm = None
+        if p.clip_gradients > 0 or self.audit:
             leaves = jax.tree_util.tree_leaves(grads)
             sumsq = sum(jnp.sum(jnp.square(g)) for g in leaves)
-            norm = jnp.sqrt(sumsq)
+            grad_norm = jnp.sqrt(sumsq)
+        # ClipGradients on raw accumulated grads (sgd_solver.cpp:84-100)
+        if p.clip_gradients > 0:
+            norm = grad_norm
             scale = jnp.where(
                 norm > p.clip_gradients, p.clip_gradients / norm, 1.0
             )
@@ -303,21 +320,33 @@ class Solver:
             new_history = {
                 k: [new_history[k][i] for i in range(len(params[k]))] for k in params
             }
-        return new_params, new_history
+        return new_params, new_history, grad_norm
+
+    def _one_iter(self, st: TrainState, batch, rng):
+        """One solver iteration (shared by both scan bodies).  With the
+        audit on, the per-iter output is ``(loss, stats)`` — the stats
+        tree is computed from values the update already produced (pure
+        readout, fused into the same program)."""
+        lrng = jax.random.fold_in(rng, st.iter)
+        grads, loss, new_stats = self._grads(st.params, st.stats, batch, lrng)
+        new_params, new_history, grad_norm = self._apply_update(
+            st.params, st.history, grads, st.iter
+        )
+        new_st = TrainState(new_params, new_stats, new_history, st.iter + 1)
+        if self.audit:
+            stats = _health.audit_iteration(
+                grads, st.params, new_params, loss, grad_norm
+            )
+            return new_st, (loss, stats)
+        return new_st, loss
 
     def _step_tau(self, state: TrainState, batches, rng):
-        """tau iterations under lax.scan (batches stacked on axis 0)."""
+        """tau iterations under lax.scan (batches stacked on axis 0).
+        Returns ``(state, losses)`` — or ``(state, (losses, stats))``
+        with the numerics audit on (leaves gain a leading tau axis)."""
 
         def one_iter(st: TrainState, batch):
-            lrng = jax.random.fold_in(rng, st.iter)
-            grads, loss, new_stats = self._grads(st.params, st.stats, batch, lrng)
-            new_params, new_history = self._apply_update(
-                st.params, st.history, grads, st.iter
-            )
-            return (
-                TrainState(new_params, new_stats, new_history, st.iter + 1),
-                loss,
-            )
+            return self._one_iter(st, batch, rng)
 
         return jax.lax.scan(one_iter, state, batches)
 
@@ -326,15 +355,7 @@ class Solver:
         the benchmarking fast path."""
 
         def one_iter(st: TrainState, _):
-            lrng = jax.random.fold_in(rng, st.iter)
-            grads, loss, new_stats = self._grads(st.params, st.stats, batch, lrng)
-            new_params, new_history = self._apply_update(
-                st.params, st.history, grads, st.iter
-            )
-            return (
-                TrainState(new_params, new_stats, new_history, st.iter + 1),
-                loss,
-            )
+            return self._one_iter(st, batch, rng)
 
         return jax.lax.scan(one_iter, state, None, length=tau)
 
@@ -347,7 +368,12 @@ class Solver:
             self._jit_step_repeat = jax.jit(
                 self._step_repeat, donate_argnums=(0,), static_argnums=(3,)
             )
-        state, losses = self._jit_step_repeat(state, batch, rng, tau)
+        state, out = self._jit_step_repeat(state, batch, rng, tau)
+        if self.audit:
+            losses, stats = out
+            self.note_losses(losses)
+            return state, losses, stats
+        losses = out
         self.note_losses(losses)
         return state, losses
 
@@ -356,7 +382,9 @@ class Solver:
     ) -> Tuple[TrainState, jax.Array]:
         """Run ``tau`` iterations where tau is the leading axis of every
         entry in ``batches`` (the ``solver_step(state, tau)`` analog,
-        ccaffe.cpp:230-233).  Returns (new_state, per-iter losses)."""
+        ccaffe.cpp:230-233).  Returns (new_state, per-iter losses) — or
+        (new_state, losses, audit_stats) when the numerics audit is on
+        (``audit=True``; see obs/health.py)."""
         rng = rng if rng is not None else train_key(0)
         if self.param.debug_info:
             first = jax.tree_util.tree_map(lambda x: x[0], batches)
@@ -364,13 +392,20 @@ class Solver:
         # the single-process round phase ("execute" in the obs span
         # vocabulary — cli train's default path has no trainer wrapper)
         with obs.span("execute"):
-            state, losses = self._jit_step(state, batches, rng)
+            state, out = self._jit_step(state, batches, rng)
+        stats = None
+        if self.audit:
+            losses, stats = out
+        else:
+            losses = out
         self.note_losses(losses)
         tm = obs.training_metrics()
         if tm is not None:
             tm.rounds.inc()
             tm.iters.inc(losses.shape[0])  # tau (shape read: no sync)
         obs.report_healthy()
+        if self.audit:
+            return state, losses, stats
         return state, losses
 
     def note_losses(self, losses) -> None:

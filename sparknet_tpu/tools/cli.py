@@ -132,6 +132,17 @@ def _cmd_train(args) -> int:
         solver = Solver(solver_param)
     # one prefix rule for BOTH writing snapshots and --resume's scan
     prefix = solver_param.snapshot_prefix or "snapshot"
+    # training-health sentry (--health/--health_policy): flips the
+    # solver's in-graph numerics audit on and guards every window;
+    # rollback restores the newest verified snapshot under the same
+    # prefix the snapshots use (obs/health.py)
+    from sparknet_tpu.obs import health as health_mod
+
+    sentry = health_mod.sentry_from_args(args, solver, echo=print)
+    if sentry is not None:
+        sentry.restore_fn = health_mod.make_restore_fn(
+            solver, prefix, trainer=trainer
+        )
     if args.resume:
         # fault-tolerant resume: newest CRC-valid snapshot under the
         # solver's snapshot_prefix; corrupt ones are quarantined and the
@@ -216,11 +227,14 @@ def _cmd_train(args) -> int:
         try:
             while it < max_iter:
                 batches = feed.next_round(r)
-                r += 1
-                if trainer is not None:
-                    state, _ = trainer.step(state, batches)
+                stepper = trainer if trainer is not None else solver
+                if sentry is not None:
+                    state, _ = sentry.guarded_step(
+                        stepper, state, batches, round_index=r
+                    )
                 else:
-                    state, _ = solver.step(state, batches)
+                    state, _ = stepper.step(state, batches)
+                r += 1
                 it += args.tau
                 # throttled logging (SolverParameter.display semantics,
                 # solver.cpp:237): reading smoothed_loss is the device
@@ -250,6 +264,15 @@ def _cmd_train(args) -> int:
                     else:
                         checkpoint.snapshot(solver, state, prefix)
                     break
+        except health_mod.SentryHalt as e:
+            # deliberately NO snapshot here: the live weights are the
+            # poisoned ones the sentry just condemned.  The flight
+            # bundle (if armed) was dumped by the sentry; /healthz
+            # reads 503 until the process exits.
+            log.log(f"training halted by the health sentry: {e}")
+            if ckpt is not None:
+                ckpt.wait()  # publish any PRE-anomaly async snapshot
+            return 1
         finally:
             # a step/snapshot exception must not leak the producer
             # thread (and its in-flight device batches)

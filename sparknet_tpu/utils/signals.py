@@ -47,6 +47,14 @@ class SignalHandler:
         effect = self._effects.get(signum)
         if effect is not None:
             self._flags[effect] = True
+        if signum == signal.SIGTERM:
+            # orchestrator shutdown: dump the flight-recorder ring (a
+            # no-op unless --flight_recorder armed one).  SIGTERM only:
+            # SIGINT/SIGHUP are routine stop/snapshot requests, not
+            # postmortem moments.
+            from sparknet_tpu.obs import flight as _flight
+
+            _flight.dump_if_active("signal_SIGTERM")
 
     def get_action(self) -> SolverAction:
         """Poll-and-clear, highest priority first (STOP beats SNAPSHOT)."""

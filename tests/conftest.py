@@ -27,6 +27,16 @@ os.environ.setdefault(
     "SPARKNET_LOG_DIR", tempfile.mkdtemp(prefix="sparknet_test_logs_")
 )
 
+# repo-hygiene baseline, captured BEFORE any test runs: tier-1 must not
+# add training_log_*.txt at the repo root (the PR-4 tmpdir-routing
+# regression guard in test_bench_smoke.py compares against this set)
+import glob as _glob  # noqa: E402
+
+REPO_ROOT_TRAINING_LOGS = frozenset(
+    os.path.basename(p)
+    for p in _glob.glob(os.path.join(_REPO_ROOT, "training_log_*.txt"))
+)
+
 from sparknet_tpu.utils.devices import force_virtual_cpu_devices  # noqa: E402
 
 force_virtual_cpu_devices(8)

@@ -115,6 +115,7 @@ def test_unknown_mode_rejected():
     assert "unknown mode 'bogus'" in out.stderr
     assert "pipeline" in out.stderr  # the error lists the valid modes
     assert "obs" in out.stderr  # ... including the telemetry mode
+    assert "health" in out.stderr  # ... and the training-health mode
     # env-var route rejects identically
     out = subprocess.run(
         [sys.executable, os.path.join(_REPO, "bench.py")],
@@ -270,6 +271,94 @@ def test_obs_traced_run_tier1_smoke(tmp_path):
     for a, h in zip(asm, h2d):
         assert a["args"]["round"] == h["args"]["round"]
         assert a["ts"] + a["dur"] <= h["ts"] + 1.0
+
+
+@pytest.mark.slow
+def test_health_mode_smoke():
+    """bench.py --mode=health end to end in a subprocess: overhead A/B,
+    bit-identity, seeded-NaN detection, rollback recovery, and the
+    flight bundle folded by tools/health_report.py."""
+    rec = _run_bench({
+        "BENCH_MODE": "health", "BENCH_ROUNDS": "2", "BENCH_PASSES": "1",
+        "BENCH_NAN_ROUND": "3",
+    })
+    assert rec["metric"] == "health_audit_overhead_pct"
+    assert rec["bit_identical"] is True
+    assert rec["detection_exact"] is True
+    assert rec["nan_detected_round"] == rec["nan_seeded_round"] == 3
+    assert rec["rollbacks"] >= 1
+    assert rec["loss_band_ok"] is True
+    assert rec["report_first_poisoned_round"] == 3
+    # noise-bounded on a live box — only sanity here; the committed
+    # artifact pin below enforces the <2% acceptance
+    assert rec["value"] < 25.0, rec
+
+
+_HEALTH_SCHEMA_KEYS = (
+    "metric", "value", "unit", "vs_baseline", "platform", "workers",
+    "tau", "batch", "rounds", "passes", "baseline_round_ms",
+    "audit_round_ms", "overhead_audit_pct", "bit_identical", "policy",
+    "nan_seeded_round", "nan_detected_round", "detection_exact",
+    "rollbacks", "final_loss", "no_fault_final_loss", "loss_band",
+    "loss_band_ok", "flight_bundle_reason", "flight_bundle_events",
+    "flight_bundle_verdicts", "report_first_poisoned_round",
+)
+
+
+def test_committed_health_artifact_schema():
+    """HEALTH_r10.json — the training-health committed artifact: audit
+    overhead inside the acceptance budget (noise can make it negative —
+    the note discloses the floor), the audited trajectory bit-identical
+    to the unaudited one, the injected NaN detected at EXACTLY the
+    seeded round, the rollback recovering the final loss into the chaos
+    band, and the flight bundle's folded report naming that round."""
+    with open(os.path.join(_REPO, "HEALTH_r10.json")) as f:
+        d = json.load(f)
+    for key in _HEALTH_SCHEMA_KEYS:
+        assert key in d, key
+    assert d["metric"] == "health_audit_overhead_pct"
+    assert d["value"] == d["overhead_audit_pct"] < 2.0
+    assert d["vs_baseline"] == round(d["value"] / 2.0, 3) <= 1.0
+    assert d["baseline_round_ms"] > 0 and d["audit_round_ms"] > 0
+    assert d["bit_identical"] is True
+    assert d["policy"] == "rollback"
+    assert d["detection_exact"] is True
+    assert d["nan_detected_round"] == d["nan_seeded_round"]
+    assert d["report_first_poisoned_round"] == d["nan_seeded_round"]
+    assert d["rollbacks"] >= 1
+    assert d["loss_band_ok"] is True
+    assert abs(d["final_loss"] - d["no_fault_final_loss"]) <= d["loss_band"]
+    assert d["flight_bundle_reason"] == "sentry_rollback"
+    assert d["flight_bundle_events"] > 0
+    assert d["flight_bundle_verdicts"] > 0
+
+
+def test_repo_root_log_hygiene():
+    """Tier-1 runs must not litter the repo root with training_log_*.txt
+    (regression guard for the PR-4 conftest tmpdir routing): the current
+    repo-root log set must equal the session-start baseline, and a
+    default TrainingLog must route into $SPARKNET_LOG_DIR, not the CWD."""
+    import glob
+
+    import conftest
+    from sparknet_tpu.utils import TrainingLog
+
+    assert os.environ.get("SPARKNET_LOG_DIR"), "conftest routing missing"
+    now = frozenset(
+        os.path.basename(p)
+        for p in glob.glob(os.path.join(_REPO, "training_log_*.txt"))
+    )
+    new = now - conftest.REPO_ROOT_TRAINING_LOGS
+    assert not new, f"tests wrote logs into the repo root: {sorted(new)}"
+    log = TrainingLog(tag="hygiene_probe")
+    try:
+        assert os.path.dirname(os.path.abspath(log.path)) == (
+            os.path.abspath(os.environ["SPARKNET_LOG_DIR"])
+        )
+        assert not os.path.abspath(log.path).startswith(_REPO + os.sep)
+    finally:
+        log.close()
+        os.unlink(log.path)
 
 
 _PIPELINE_SCHEMA_KEYS = (

@@ -20,6 +20,13 @@ def test_default_plan_covers_every_fault_class():
     assert plan.storage_faults and plan.stall_rounds
     assert plan.preempt_round is not None and plan.corrupt_newest
     assert plan.dead_worker is not None
+    # the divergence fault: a poisoned worker at a seeded round, caught
+    # by the numerics audit before the average (obs/health.py)
+    assert plan.nan_round is not None and plan.nan_workers
+    # nan fires before the preemption so the detection isn't lost to
+    # the resume replay, and on a different worker than the dead one
+    assert plan.nan_round < plan.preempt_round
+    assert plan.dead_worker not in plan.nan_workers
     # the preemption must happen after at least one periodic snapshot,
     # or there is nothing valid to fall back to after the corruption
     assert plan.preempt_round + 1 > plan.snapshot_every
@@ -29,7 +36,7 @@ def test_no_fault_view_strips_all_faults():
     base = chaos.FaultPlan.default().no_fault_view()
     assert base.storage_faults == () and base.stall_rounds == ()
     assert base.preempt_round is None and not base.corrupt_newest
-    assert base.dead_worker is None
+    assert base.dead_worker is None and base.nan_round is None
     # run geometry unchanged: the baseline is comparable
     plan = chaos.FaultPlan.default()
     for f in ("seed", "workers", "rounds", "tau", "batch"):
@@ -102,6 +109,7 @@ def test_feed_delivers_rounds_in_order_across_watchdog_rebuild():
         storage_faults=(), stall_rounds=(1,),
         stall_s=0.8, stall_timeout_s=0.2,
         preempt_round=None, corrupt_newest=False, dead_worker=None,
+        nan_round=None,
     )
     # distinct constant per minibatch index -> contents identify indices
     xs = [np.full((4, 3, 4, 4), i, np.float32) for i in range(8)]

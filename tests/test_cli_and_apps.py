@@ -205,6 +205,64 @@ def test_cli_train_devices_allreduce(tmp_path, toy_model, cifar_dir, capsys):
     assert "resumed from" in capsys.readouterr().out
 
 
+def test_cli_train_health_sentry_warn_and_halt(
+    tmp_path, toy_model, capsys, monkeypatch
+):
+    """`train --health`: a healthy run completes under the audit with
+    zero anomalies; a diverging run (absurd LR -> non-finite within a
+    couple of windows) under policy=halt exits rc 1 WITHOUT
+    snapshotting the condemned weights and dumps the flight bundle
+    (ISSUE 5 wiring).  The global sentry is scoped to the run — after
+    cli.main returns, /healthz must no longer see it (a later run in
+    the same process must not inherit a halted sentry)."""
+    from sparknet_tpu import obs
+    from sparknet_tpu.obs import flight
+
+    captured = []
+    real_set = obs.set_sentry
+
+    def spy(s):
+        if s is not None:
+            captured.append(s)
+        real_set(s)
+
+    monkeypatch.setattr(obs, "set_sentry", spy)
+
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(
+        f'net: "{toy_model}"\nbase_lr: 0.01\nlr_policy: "fixed"\n'
+        "max_iter: 4\n"
+        f'snapshot_prefix: "{tmp_path}/h"\n'
+    )
+    rc = cli.main(["train", f"--solver={solver}", "--tau=2", "--health"])
+    assert rc == 0
+    assert obs.sentry_state() is None  # run teardown cleared the global
+    st = captured[-1].state_dict()
+    assert st["policy"] == "warn"
+    assert st["halted"] is False and st["anomalies"] == 0
+    capsys.readouterr()
+
+    bad = tmp_path / "bad_solver.prototxt"
+    bad.write_text(
+        f'net: "{toy_model}"\nbase_lr: 1e38\nlr_policy: "fixed"\n'
+        "max_iter: 40\n"
+        f'snapshot_prefix: "{tmp_path}/hb"\n'
+    )
+    bundle = str(tmp_path / "flight.json")
+    rc = cli.main([
+        "train", f"--solver={bad}", "--tau=2",
+        "--health", "halt", f"--flight_recorder={bundle}",
+    ])
+    assert rc == 1
+    assert "halted by the health sentry" in capsys.readouterr().out
+    b = flight.load_bundle(bundle)
+    assert b["reason"] == "sentry_halt"
+    assert b["sentry"]["halted"] is True
+    # the condemned weights were NOT snapshotted
+    assert not [f for f in os.listdir(tmp_path) if f.startswith("hb_iter_")]
+    obs._reset_training_metrics_for_tests()
+
+
 def test_cli_train_obs_flags_write_trace_and_serve_metrics(
     tmp_path, toy_model, capsys
 ):

@@ -1,0 +1,130 @@
+"""Fold a flight-recorder bundle or JSONL run log into a round-by-round
+training-health table.
+
+Input: either a postmortem bundle dumped by the crash flight recorder
+(``obs/flight.py`` — carries the sentry's verdict ring directly) or a
+``--trace_out`` sibling ``.jsonl`` run log (the sentry emits one
+``health`` instant per round).  Output: one row per observed round with
+loss, spike z-score, grad norm, non-finite count, masked workers and
+the action taken — and the headline a postmortem wants first:
+**which round poisoned the run** (``first_poisoned_round``).
+
+    python tools/health_report.py flight_postmortem.json
+    python tools/health_report.py RUN.trace.jsonl --json   # machine form
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+
+def load_records(path: str) -> List[dict]:
+    """Verdict dicts (the ``HealthVerdict.as_dict`` shape), from either
+    source, ordered by round."""
+    if path.endswith(".jsonl"):
+        records = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                if rec.get("kind") == "instant" and rec.get("name") == "health":
+                    records.append(rec.get("args", {}))
+        return records
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("kind") == "sparknet_flight_bundle":
+        return list(doc.get("verdicts", []))
+    raise ValueError(
+        f"{path}: expected a sparknet flight bundle (.json) or a run log "
+        "(.jsonl)"
+    )
+
+
+def fold(records: List[dict]) -> Dict[str, object]:
+    rounds = sorted(
+        (r for r in records if "round" in r), key=lambda r: r["round"]
+    )
+    first_poisoned: Optional[int] = None
+    anomalies = 0
+    actions: Dict[str, int] = {}
+    for r in rounds:
+        if not r.get("ok", True):
+            anomalies += 1
+            if first_poisoned is None and r.get("nonfinite", 0) > 0:
+                first_poisoned = int(r["round"])
+        a = r.get("action", "none")
+        if a != "none":
+            actions[a] = actions.get(a, 0) + 1
+    # a pure loss-spike run has no non-finite round; the first flagged
+    # round is still the answer to "which round went bad"
+    if first_poisoned is None:
+        flagged = [r for r in rounds if not r.get("ok", True)]
+        if flagged:
+            first_poisoned = int(flagged[0]["round"])
+    return {
+        "rounds_observed": len(rounds),
+        "anomalies": anomalies,
+        "first_poisoned_round": first_poisoned,
+        "actions": actions,
+        "rounds": rounds,
+    }
+
+
+def format_report(rep: Dict[str, object]) -> str:
+    lines = [
+        "%-6s %10s %8s %10s %9s %-10s %-9s %s"
+        % ("round", "loss", "z", "grad_norm", "nonfinite", "masked",
+           "action", "reasons")
+    ]
+    for r in rep["rounds"]:
+        lines.append(
+            "%-6d %10.4g %8.2f %10.4g %9d %-10s %-9s %s"
+            % (
+                r.get("round", -1),
+                r.get("loss", float("nan")),
+                r.get("zscore", 0.0),
+                r.get("grad_norm", float("nan")),
+                r.get("nonfinite", 0),
+                ",".join(str(w) for w in r.get("masked_workers", [])) or "-",
+                r.get("action", "none"),
+                ",".join(r.get("reasons", [])) or "-",
+            )
+        )
+    lines.append(
+        "rounds: %d | anomalies: %d | actions: %s"
+        % (
+            rep["rounds_observed"], rep["anomalies"],
+            rep["actions"] or "none",
+        )
+    )
+    fp = rep["first_poisoned_round"]
+    lines.append(
+        "first poisoned round: %s"
+        % ("none — run healthy" if fp is None else fp)
+    )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "source", help="flight bundle .json or run-log .jsonl"
+    )
+    ap.add_argument("--json", action="store_true",
+                    help="emit the folded report as JSON")
+    args = ap.parse_args(argv)
+    rep = fold(load_records(args.source))
+    if args.json:
+        print(json.dumps(rep, indent=2))
+    else:
+        print(format_report(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
