@@ -40,8 +40,10 @@ def main(argv=None) -> int:
         "transfers collapse throughput (PERF.md)",
     )
     from sparknet_tpu import obs
+    from sparknet_tpu.parallel import comm
 
     obs.add_cli_args(parser)  # --obs / --obs_port / --trace_out
+    comm.add_cli_args(parser)  # --compress / --overlap_avg
     args = parser.parse_args(argv)
 
     import jax
@@ -124,7 +126,11 @@ def main(argv=None) -> int:
     from sparknet_tpu.obs import health as health_mod
 
     sentry = health_mod.sentry_from_args(args, solver, echo=log.log)
-    trainer = ParameterAveragingTrainer(solver, mesh)
+    # --compress/--overlap_avg: comm-plane averaging (delta-quantized,
+    # chunked, optionally overlapped — parallel/comm.py)
+    trainer = ParameterAveragingTrainer(
+        solver, mesh, **comm.comm_kwargs_from_args(args)
+    )
     state = trainer.init_state(seed=args.seed)
     test_batches, test_counts = ParameterAveragingTrainer.pad_partitions(
         test_parts
@@ -161,6 +167,8 @@ def main(argv=None) -> int:
     try:
         for r in range(args.rounds):
             if r % args.test_every == 0:  # test before train, CifarApp.scala:101
+                # land any in-flight overlapped average before scoring
+                state = trainer.finalize(state)
                 log.log(f"round {r}, accuracy {evaluate(r):.4f}")
             if sentry is not None:
                 state, _ = sentry.guarded_round(
@@ -171,6 +179,7 @@ def main(argv=None) -> int:
             log.log(
                 f"round {r} trained, smoothed_loss {solver.smoothed_loss:.4f}"
             )
+        state = trainer.finalize(state)  # last round's average lands
         log.log(f"final accuracy {evaluate():.4f}")
         return 0
     except health_mod.SentryHalt as e:

@@ -59,8 +59,10 @@ def main(argv=None) -> int:
         "links)",
     )
     from sparknet_tpu import obs
+    from sparknet_tpu.parallel import comm
 
     obs.add_cli_args(parser)  # --obs / --obs_port / --trace_out
+    comm.add_cli_args(parser)  # --compress / --overlap_avg
     args = parser.parse_args(argv)
 
     import jax
@@ -115,7 +117,9 @@ def main(argv=None) -> int:
     from sparknet_tpu.obs import health as health_mod
 
     sentry = health_mod.sentry_from_args(args, solver, echo=log.log)
-    trainer = ParameterAveragingTrainer(solver, mesh)
+    trainer = ParameterAveragingTrainer(
+        solver, mesh, **comm.comm_kwargs_from_args(args)
+    )
     state = trainer.init_state(seed=args.seed)
     log.log("nets ready")
 
@@ -152,6 +156,7 @@ def main(argv=None) -> int:
                 f"round {r} trained, smoothed_loss {solver.smoothed_loss:.4f}"
             )
 
+        state = trainer.finalize(state)  # last round's average lands
         # eval from the test DB
         nb = 2
         tb = [test_pipe.next() for _ in range(args.workers * nb)]

@@ -85,8 +85,10 @@ def main(argv=None) -> int:
         "training loop) — for relay-degraded links (PERF.md)",
     )
     from sparknet_tpu import obs
+    from sparknet_tpu.parallel import comm
 
     obs.add_cli_args(parser)  # --obs / --obs_port / --trace_out
+    comm.add_cli_args(parser)  # --compress / --overlap_avg
     args = parser.parse_args(argv)
 
     import jax
@@ -290,7 +292,9 @@ def main(argv=None) -> int:
     from sparknet_tpu.obs import health as health_mod
 
     sentry = health_mod.sentry_from_args(args, solver, echo=log.log)
-    trainer = ParameterAveragingTrainer(solver, mesh)
+    trainer = ParameterAveragingTrainer(
+        solver, mesh, **comm.comm_kwargs_from_args(args)
+    )
     state = trainer.init_state(seed=args.seed)
     test_on_dev = shard_leading_global(test_batches, mesh)
     log.log("finished setting up nets and weights")
@@ -321,6 +325,8 @@ def main(argv=None) -> int:
     try:
         for r in range(args.rounds):
             if r % args.test_every == 0:  # test-then-train, ImageNetApp.scala:118
+                # land any in-flight overlapped average before scoring
+                state = trainer.finalize(state)
                 log.log(f"{evaluate(r) * 100:.2f}% accuracy", i=r)
             log.log("training", i=r)
             if sentry is not None:
@@ -332,6 +338,7 @@ def main(argv=None) -> int:
             log.log(
                 f"trained, smoothed_loss {solver.smoothed_loss:.4f}", i=r
             )
+        state = trainer.finalize(state)  # last round's average lands
         acc = evaluate()
         log.log(f"final accuracy {acc * 100:.2f}%")
         if jax.process_index() == 0:

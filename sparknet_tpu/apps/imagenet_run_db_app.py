@@ -57,8 +57,10 @@ def main(argv=None) -> int:
         "links)",
     )
     from sparknet_tpu import obs
+    from sparknet_tpu.parallel import comm
 
     obs.add_cli_args(parser)  # --obs / --obs_port / --trace_out
+    comm.add_cli_args(parser)  # --compress / --overlap_avg
     args = parser.parse_args(argv)
 
     import jax
@@ -139,7 +141,9 @@ def main(argv=None) -> int:
 
     sentry = health_mod.sentry_from_args(args, solver, echo=log.log)
     mesh = make_mesh({"dp": n_workers}, devices=jax.devices()[:n_workers])
-    trainer = ParameterAveragingTrainer(solver, mesh)
+    trainer = ParameterAveragingTrainer(
+        solver, mesh, **comm.comm_kwargs_from_args(args)
+    )
     state = trainer.init_state(seed=args.seed)
 
     prefix = args.snapshot_prefix or os.path.join(args.db_dir, "imagenet_db")
@@ -221,6 +225,8 @@ def main(argv=None) -> int:
     try:
         for r in range(start_round, start_round + args.rounds):
             if r % args.test_every == 0:
+                # land any in-flight overlapped average before scoring
+                state = trainer.finalize(state)
                 log.log(f"{evaluate() * 100:.2f}% accuracy", i=r)
             log.log("training", i=r)
             if sentry is not None:
@@ -231,12 +237,16 @@ def main(argv=None) -> int:
                 state, _ = trainer.round(state, feed.next_round(r))
             log.log(f"trained, smoothed_loss {solver.smoothed_loss:.4f}", i=r)
             if args.snapshot_every and (r + 1) % args.snapshot_every == 0:
+                # a snapshot must capture the round's AVERAGE, not a
+                # mid-flight overlapped state
+                state = trainer.finalize(state)
                 st = first_worker(jax.device_get(state))
                 model_path, state_path = checkpoint.snapshot(
                     solver, st, prefix
                 )
                 log.log(f"snapshot -> {model_path}", i=r)
 
+        state = trainer.finalize(state)  # last round's average lands
         acc = evaluate()
         log.log(f"final accuracy {acc * 100:.2f}%")
         print(f"final accuracy {acc * 100:.2f}%")

@@ -135,7 +135,7 @@ class TrainingMetrics:
         self.phase_latency = registry.histogram(
             "sparknet_phase_latency_seconds",
             "wall seconds per round phase (assemble/h2d/execute/average/"
-            "snapshot/restore)",
+            "quantize/allreduce/dequantize/snapshot/restore)",
             labels=("phase",),
         )
         self.feed_queue_depth = registry.gauge(
@@ -164,6 +164,13 @@ class TrainingMetrics:
             "sparknet_faults_total",
             "chaos-injected faults observed, by kind",
             labels=("kind",),
+        )
+        self.collective_bytes = registry.counter(
+            "sparknet_collective_bytes_total",
+            "modeled interconnect payload bytes moved by the parameter-"
+            "averaging collective (ring factor x compressed payload), "
+            "by compression mode",
+            labels=("compress",),
         )
         self.jit_cache = registry.gauge(
             "sparknet_jit_cache_size",

@@ -17,6 +17,7 @@ mesh just spans hosts, and XLA routes collectives over ICI within a slice
 and DCN across slices.
 """
 
+from sparknet_tpu.parallel import comm  # noqa: F401
 from sparknet_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
     local_device_count,

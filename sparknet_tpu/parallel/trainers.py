@@ -22,7 +22,6 @@ multi-host pod (see ``mesh.initialize_distributed``).
 
 from __future__ import annotations
 
-import functools
 from typing import Dict, Optional
 
 import jax
@@ -42,21 +41,55 @@ from sparknet_tpu.utils.rngs import train_key
 tree_map = jax.tree_util.tree_map
 
 
-@functools.lru_cache(maxsize=256)
+# Sharding cache, keyed on MESH IDENTITY: the per-mesh dict lives on
+# the mesh object itself, so its lifetime is exactly the mesh's — a
+# process that recreates meshes (every test file does) can never grow a
+# module-level cache monotonically, and an equal mesh (jax interns
+# Mesh, so equal specs ARE the same object) reuses the same shardings.
+# A module-level lru keyed on Mesh would instead pin every mesh it ever
+# saw (NamedSharding holds the mesh strongly, so even a weak-key dict
+# can't evict).  Fallback for a Mesh that rejects attributes: a small
+# bounded dict, cleared on overflow like ``_place_live``'s.
+_SHARDING_ATTR = "_sparknet_shardings"
+_sharding_fallback: Dict = {}
+
+
+def _mesh_sharding_cache(mesh: Mesh) -> Dict:
+    cache = getattr(mesh, _SHARDING_ATTR, None)
+    if cache is None:
+        cache = {}
+        try:
+            setattr(mesh, _SHARDING_ATTR, cache)
+        except (AttributeError, TypeError):  # pragma: no cover
+            if len(_sharding_fallback) >= 64:
+                _sharding_fallback.clear()
+            cache = _sharding_fallback.setdefault(mesh, {})
+    return cache
+
+
 def leading_sharding(mesh: Mesh, axis: str = "dp") -> NamedSharding:
     """The leading-axis placement ``NamedSharding(mesh, P(axis))``,
     built ONCE per (mesh, axis) — the training loops place a batch with
     this every round, and rebuilding the sharding object per round is
-    avoidable host work on the hot path (meshes are few and long-lived,
-    so the cache stays tiny)."""
-    return NamedSharding(mesh, P(axis))
+    avoidable host work on the hot path.  Cached ON the mesh object
+    (mesh identity), so repeated trainer/mesh construction cannot grow
+    a global cache."""
+    cache = _mesh_sharding_cache(mesh)
+    key = ("lead", axis)
+    s = cache.get(key)
+    if s is None:
+        s = cache.setdefault(key, NamedSharding(mesh, P(axis)))
+    return s
 
 
-@functools.lru_cache(maxsize=256)
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     """Fully-replicated placement ``NamedSharding(mesh, P())``, cached
     like ``leading_sharding``."""
-    return NamedSharding(mesh, P())
+    cache = _mesh_sharding_cache(mesh)
+    s = cache.get("repl")
+    if s is None:
+        s = cache.setdefault("repl", NamedSharding(mesh, P()))
+    return s
 
 
 def replicate(tree, mesh: Mesh):
@@ -145,11 +178,23 @@ class ParameterAveragingTrainer:
         average_stats: bool = True,
         average_params: bool = True,
         mask_nonfinite: bool = True,
+        compress: str = "none",
+        overlap_avg: bool = False,
+        comm_chunks: Optional[int] = None,
+        overlap_steps: Optional[int] = None,
+        comm_cost_ms_per_mb: Optional[float] = None,
     ):
         """``average_params=False`` skips the cross-worker pmean — a
         DIAGNOSTIC mode (workers then train fully independently): the
         scaling bench A/Bs it against the real round to attribute round
         time to compute vs collective.
+
+        ``compress``/``overlap_avg`` engage the comm plane
+        (``parallel/comm.py``): delta-quantized (bf16/int8) chunked
+        collectives, optionally overlapped with the next round's first
+        local steps.  The default (``compress='none'``,
+        ``overlap_avg=False``) keeps the classic fused round,
+        bit-identical to the pre-comm-plane trainer.
 
         With the solver's numerics audit on (``solver.audit`` — set it
         BEFORE constructing the trainer; the audit arity is baked into
@@ -169,6 +214,40 @@ class ParameterAveragingTrainer:
         self.num_workers = mesh.shape[axis]
         self.audit = bool(getattr(solver, "audit", False))
         self.mask_nonfinite = bool(mask_nonfinite) and self.audit
+        self.average_params = bool(average_params)
+        self.average_stats = bool(average_stats)
+
+        # the comm plane (parallel/comm.py): engaged for compressed
+        # and/or overlapped averaging; None on the default path, which
+        # keeps the fused round below bit-identical to the classic
+        # trainer
+        from sparknet_tpu.parallel import comm as _comm
+
+        if compress not in _comm.COMPRESS_MODES:
+            raise ValueError(
+                f"compress={compress!r}: expected one of "
+                f"{_comm.COMPRESS_MODES}"
+            )
+        self.compress = compress
+        self._comm = None
+        if (compress != "none" or overlap_avg) and average_params:
+            self._comm = _comm.CommPlane(
+                solver, mesh, axis,
+                compress=compress,
+                overlap=overlap_avg,
+                chunks=(
+                    _comm.DEFAULT_CHUNKS
+                    if comm_chunks is None else comm_chunks
+                ),
+                overlap_steps=(
+                    _comm.DEFAULT_OVERLAP_STEPS
+                    if overlap_steps is None else overlap_steps
+                ),
+                cost_ms_per_mb=comm_cost_ms_per_mb,
+                average_stats=average_stats,
+                mask_nonfinite=mask_nonfinite,
+            )
+        self._fused_payload_bytes: Optional[int] = None
 
         audit = self.audit
         mask_nf = self.mask_nonfinite
@@ -329,6 +408,13 @@ class ParameterAveragingTrainer:
         reference's restore-on-every-executor semantics.  The resume
         entry for ``imagenet_run_db_app --resume``, the chaos harness,
         and the sentry's rollback path."""
+        if self._comm is not None:
+            # a restored state invalidates the comm plane's carried
+            # anchor/residual and any in-flight collective — a stale
+            # correction applied onto restored params would corrupt
+            # them (the residual reset mirrors the momentum-zeroing
+            # rejoin contract)
+            self._comm.reset()
         n = self.num_workers
         stacked = tree_map(
             lambda x: np.broadcast_to(
@@ -404,13 +490,42 @@ class ParameterAveragingTrainer:
             if live_mask is None:
                 live_mask = np.ones((self.num_workers,), np.float32)
             live = self._place_live(live_mask)  # cached per mask value
-            with obs.span("execute"):
+            if self._comm is not None:
+                # comm plane: delta-quantized chunked collectives,
+                # optionally overlapped with the next round's compute
+                out = self._comm.round(
+                    state, batches, rng, live, live_mask
+                )
                 if self.audit:
-                    state, losses, astats = self._round(
-                        state, batches, rng, live
-                    )
+                    state, losses, astats = out
                 else:
-                    state, losses = self._round(state, batches, rng, live)
+                    state, losses = out
+            else:
+                with obs.span("execute"):
+                    if self.audit:
+                        state, losses, astats = self._round(
+                            state, batches, rng, live
+                        )
+                    else:
+                        state, losses = self._round(
+                            state, batches, rng, live
+                        )
+                tm = obs.training_metrics()
+                if tm is not None and self.average_params:
+                    # the fused fp32 collective's modeled wire bytes
+                    # (ring factor x params+stats payload) — computed
+                    # once, charged per round
+                    if self._fused_payload_bytes is None:
+                        from sparknet_tpu.parallel import comm as _comm
+
+                        self._fused_payload_bytes = (
+                            _comm.fused_round_payload_bytes(
+                                state, self.average_stats
+                            )
+                        )
+                    tm.collective_bytes.labels("none").inc(
+                        self._fused_payload_bytes
+                    )
             # recorded lazily: smoothed_loss pulls the worker-mean of the
             # addressable shards on read (Solver._drain_losses) — no
             # device->host sync in the round loop
@@ -423,6 +538,16 @@ class ParameterAveragingTrainer:
         if self.audit:
             return state, losses, astats
         return state, losses
+
+    def finalize(self, state: TrainState) -> TrainState:
+        """Land any in-flight overlapped averaging collective into
+        ``state`` (``--overlap_avg``): call before an eval or at the
+        end of training so the last round's average is applied.
+        No-op on the default (fused) path and when nothing is
+        pending."""
+        if self._comm is not None:
+            return self._comm.finalize(state)
+        return state
 
     def test_and_store_result(
         self, state: TrainState, batches: Dict[str, jax.Array], counts=None

@@ -70,6 +70,22 @@ def cmd_train(args) -> int:
             file=sys.stderr,
         )
         return 1
+    if getattr(args, "compress", "none") != "none" or getattr(
+        args, "overlap_avg", False
+    ):
+        # cli train's dp mode is per-step gradient allreduce (the
+        # P2PSync analog) — there is no tau-step parameter delta to
+        # quantize or overlap.  The comm plane lives on the parameter-
+        # averaging drivers.
+        print(
+            "train: --compress/--overlap_avg apply to tau-round "
+            "parameter averaging — use the averaging apps "
+            "(sparknet_tpu.apps.cifar_app / cifar_db_app / "
+            "imagenet_app / imagenet_run_db_app); cli train's "
+            "--devices mode is per-step gradient allreduce",
+            file=sys.stderr,
+        )
+        return 1
 
     # telemetry first, so restore/snapshot spans and the /metrics
     # sidecar cover the whole run (both flags off -> pure no-op)
@@ -825,8 +841,10 @@ def main(argv=None) -> int:
         "--sighup_effect", choices=["stop", "snapshot", "none"], default="snapshot"
     )
     from sparknet_tpu import obs as _obs
+    from sparknet_tpu.parallel import comm as _comm
 
     _obs.add_cli_args(p)  # --obs / --obs_port / --trace_out
+    _comm.add_cli_args(p)  # --compress / --overlap_avg
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("test")
