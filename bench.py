@@ -76,6 +76,19 @@ Modes (env):
                         policy recovers the final loss to within the
                         chaos loss band (HEALTH_r10.json artifact)
 
+  BENCH_MODE=profile    round-anatomy profiler proof (sparknet_tpu/obs/
+                        profile.py): A/Bs the pipelined cifar10_quick
+                        loop with the RoundProfiler off vs on (overhead
+                        vs the noise floor), measures the LIVE hidden
+                        fraction of the RoundFeed overlap against
+                        PIPELINE_r08's offline overlap efficiency,
+                        seeds a straggling worker and requires the
+                        profiler to attribute it exactly, measures the
+                        CommPlane chunk-overlap hidden fraction, and
+                        cross-checks the analytic FLOP model against
+                        XLA's cost analysis (PROFILE_r11.json artifact;
+                        gated by tools/perf_gate.py --check)
+
 Modes can also be selected as ``python bench.py --mode=serve`` (flag
 wins over the env var); an unknown mode is rejected.
   BENCH_PROFILE=1       also print the `caffe time`-style per-layer table
@@ -97,7 +110,7 @@ if _REPO not in sys.path:
 
 _MODES = (
     "train", "hostfeed", "scaling", "serve", "chaos", "pipeline", "obs",
-    "health",
+    "health", "profile",
 )
 _MODE = os.environ.get("BENCH_MODE", "train")
 for _i, _a in enumerate(sys.argv[1:], start=1):
@@ -115,7 +128,7 @@ if _MODE not in _MODES:
         "bench.py: unknown mode %r (expected one of %s)"
         % (_MODE, "|".join(_MODES))
     )
-if _MODE in ("scaling", "chaos", "pipeline", "obs", "health"):
+if _MODE in ("scaling", "chaos", "pipeline", "obs", "health", "profile"):
     # these modes need >1 device; on a 1-chip host force the virtual CPU
     # mesh (the driver's multichip validation environment).  This must run
     # BEFORE the first backend use (XLA_FLAGS is parsed once per process),
@@ -1009,8 +1022,9 @@ def _bench_comm_ab():
         "metric": "comm_overlap_round_vs_ideal",
         "value": round(overlap_vs_ideal, 3),
         "unit": "overlapped round / max(collective, local)",
-        # done-bar: <= 1.15 x the ideal
-        "vs_baseline": round(overlap_vs_ideal / 1.15, 3),
+        # done-bar: <= 1.15 x the ideal (derived from the ROUNDED value
+        # so the artifact is self-consistent under re-derivation)
+        "vs_baseline": round(round(overlap_vs_ideal, 3) / 1.15, 3),
         "platform": jax.devices()[0].platform,
         "workers": workers,
         "tau": tau,
@@ -1587,7 +1601,8 @@ def bench_obs():
         "value": round(overhead_traced_pct, 3),
         "unit": "% of uninstrumented round time",
         # done-bar: <= 1.0, i.e. inside the 2% acceptance budget
-        "vs_baseline": round(overhead_traced_pct / 2.0, 3),
+        # (derived from the ROUNDED value: self-consistent artifact)
+        "vs_baseline": round(round(overhead_traced_pct, 3) / 2.0, 3),
         "platform": jax.devices()[0].platform,
         "workers": workers,
         "tau": tau,
@@ -1772,6 +1787,8 @@ def bench_health():
         storage_faults=(), stall_rounds=(), preempt_round=None,
         corrupt_newest=False, dead_worker=None,
         nan_round=nan_round, nan_workers=tuple(range(workers)),
+        straggler_round=None,  # this mode proves the SENTRY, not the
+        # profiler (the chaos smoke owns straggler attribution)
     )
 
     def chaos_run(p, sentry=None, snapshot_prefix=None, snapshot_every=2):
@@ -1858,7 +1875,8 @@ def bench_health():
         "value": round(overhead_pct, 3),
         "unit": "% of unaudited round time",
         # done-bar: <= 1.0, i.e. inside the 2% acceptance budget
-        "vs_baseline": round(overhead_pct / 2.0, 3),
+        # (derived from the ROUNDED value: self-consistent artifact)
+        "vs_baseline": round(round(overhead_pct, 3) / 2.0, 3),
         "platform": jax.devices()[0].platform,
         "workers": workers,
         "tau": tau,
@@ -1903,6 +1921,309 @@ def bench_health():
     print(json.dumps(out))
 
 
+def bench_profile():
+    """Round-anatomy profiler proof (``sparknet_tpu/obs/profile.py``).
+
+    Five legs over the bench_obs protocol (pipelined cifar10_quick loop
+    on the virtual dp mesh):
+
+    1. **overhead A/B** — RoundProfiler off vs on (span folding + the
+       per-shard execute probe), warmed + best-of-N; disclosed against
+       this box's +/-1-3% noise floor (the OBS_r09/HEALTH_r10
+       contract).
+    2. **live hidden fraction** — the profiler's measured RoundFeed
+       hidden fraction over a profiled run, required to sit within
+       band of PIPELINE_r08's offline overlap efficiency (the live
+       counterpart of the 0.97 number).
+    3. **straggler attribution** — one worker's assembly is seeded
+       slow every round; the profiler's verdict must name EXACTLY that
+       worker.
+    4. **comm overlap** — the same loop under the int8 overlapped comm
+       plane; the profiler's chunk-overlap hidden fraction is recorded.
+    5. **MFU/roofline cross-check** — the analytic utils/flops.py MXU
+       count vs XLA's own cost_analysis of the compiled round, plus
+       payload bytes and the per-phase bound classification.
+    """
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from sparknet_tpu import config as cfg, models, obs
+    from sparknet_tpu.data import CifarLoader, RoundFeed
+    from sparknet_tpu.obs import profile as profile_mod
+    from sparknet_tpu.parallel import ParameterAveragingTrainer, make_mesh
+    from sparknet_tpu.solver import Solver
+
+    workers = int(os.environ.get("BENCH_WORKERS", "2"))
+    tau = int(os.environ.get("BENCH_TAU", "2"))
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    rounds = int(os.environ.get("BENCH_ROUNDS", "5"))
+    passes = max(1, int(os.environ.get("BENCH_PASSES", "3")))
+    anatomy_rounds = int(os.environ.get("BENCH_PROFILE_ROUNDS", "8"))
+    straggler_worker = int(
+        os.environ.get("BENCH_STRAGGLER_WORKER", str(workers - 1))
+    )
+    straggler_ms = float(os.environ.get("BENCH_STRAGGLER_MS", "250"))
+
+    workdir = tempfile.mkdtemp(prefix="bench_profile_")
+    data_dir = os.path.join(workdir, "data")
+    CifarLoader.write_synthetic(data_dir, num_train=256, num_test=32, seed=11)
+    xs, ys = CifarLoader(data_dir).minibatches(batch, train=True)
+
+    def window(r):
+        n = len(xs)
+        data = np.empty((workers, tau) + xs[0].shape, np.float32)
+        label = np.empty((workers, tau, batch), np.float32)
+        for w in range(workers):
+            for t in range(tau):
+                i = (r * workers * tau + w * tau + t) % n
+                data[w, t] = xs[i]
+                label[w, t] = ys[i]
+        return {"data": data, "label": label}
+
+    netp = cfg.replace_data_layers(
+        models.load_model("cifar10_quick"),
+        [(batch, 3, 32, 32), (batch,)],
+        [(batch, 3, 32, 32), (batch,)],
+    )
+    solver = Solver(models.load_model_solver("cifar10_quick"), net_param=netp)
+    mesh = make_mesh({"dp": workers}, devices=jax.devices()[:workers])
+    trainer = ParameterAveragingTrainer(solver, mesh)
+
+    assembly_s = float(os.environ.get("BENCH_PROFILE_ASSEMBLY_MS", "25")) / 1e3
+
+    def make_assemble(straggle_worker=None, straggle_s=0.0):
+        def assemble(r, out):
+            times = []
+            for w in range(workers):
+                t0 = time.perf_counter()
+                if w == straggle_worker and r >= 1:
+                    time.sleep(straggle_s)
+                # share the common host-I/O stand-in across workers
+                time.sleep(assembly_s / workers)
+                times.append(time.perf_counter() - t0)
+            profile_mod.note_worker_phase(r, "assemble", times)
+            return window(r)
+
+        return assemble
+
+    def run_loop(assemble, n_rounds, tr=None):
+        tr = tr or trainer
+        feed = RoundFeed(assemble, mesh=mesh, num_rounds=n_rounds + 1)
+        try:
+            state = tr.init_state(seed=0)
+            out = tr.round(state, feed.next_round(0))
+            state, losses = out[0], out[1]
+            jax.block_until_ready(losses)  # compile + warm off the clock
+            t0 = time.perf_counter()
+            for r in range(1, n_rounds + 1):
+                out = tr.round(state, feed.next_round(r))
+                state, losses = out[0], out[1]
+                jax.block_until_ready(losses)
+            dt = (time.perf_counter() - t0) / n_rounds
+            tr.finalize(state)
+            return dt
+        finally:
+            feed.stop()
+
+    def best_of(n):
+        run_loop(make_assemble(), rounds)  # per-leg steady-state entry
+        return min(run_loop(make_assemble(), rounds) for _ in range(n))
+
+    # ---- leg 1: overhead A/B (profiler off vs on)
+    assert profile_mod.active() is None
+    run_loop(make_assemble(), rounds)  # whole-path warmup
+    base_s = best_of(passes)
+    profiler = profile_mod.install(profile_mod.RoundProfiler())
+    try:
+        prof_s = best_of(passes)
+    finally:
+        profile_mod.uninstall(profiler)
+    overhead_pct = (prof_s - base_s) / base_s * 100.0
+
+    # ---- leg 2: live hidden fraction over a longer profiled run (the
+    # first prefetch-depth rounds honestly read 0 — the feed ran ahead
+    # before training started — so the p50 is the steady-state number)
+    profiler = profile_mod.install(profile_mod.RoundProfiler())
+    try:
+        run_loop(make_assemble(), anatomy_rounds)
+        anatomy = profiler.summary()
+    finally:
+        profile_mod.uninstall(profiler)
+    hidden = anatomy.get("hidden_frac_h2d") or {}
+    with open(os.path.join(_REPO, "PIPELINE_r08.json")) as f:
+        pipeline_art = json.load(f)
+    offline_eff = float(pipeline_art["overlap_efficiency"])
+    # ONE definition of the live-vs-offline band: the gate's cross-rule
+    # must agree with the hidden_within_band the artifact records
+    import importlib.util as _ilu
+
+    _pg_spec = _ilu.spec_from_file_location(
+        "perf_gate", os.path.join(_REPO, "tools", "perf_gate.py")
+    )
+    _pg = _ilu.module_from_spec(_pg_spec)
+    _pg_spec.loader.exec_module(_pg)
+    hidden_band = _pg.HIDDEN_FRACTION_BAND
+    hidden_p50 = hidden.get("p50")
+    hidden_within = bool(
+        hidden_p50 is not None and hidden_p50 >= offline_eff - hidden_band
+    )
+
+    # ---- leg 3: seeded straggler, exact attribution required
+    profiler = profile_mod.install(profile_mod.RoundProfiler())
+    try:
+        run_loop(
+            make_assemble(straggler_worker, straggler_ms / 1e3), rounds
+        )
+        straggler_summary = profiler.summary()
+        detected_worker = profiler.last_straggler_worker
+        detected_round = profiler.last_straggler_round
+        strag_rounds = profiler.straggler_rounds
+    finally:
+        profile_mod.uninstall(profiler)
+    straggler_attributed = bool(
+        detected_worker == straggler_worker and strag_rounds >= 1
+    )
+
+    # ---- leg 4: comm-plane chunk overlap (int8 delta averaging on a
+    # comm thread; the profiler measures the chunk hidden fraction)
+    comm_trainer = ParameterAveragingTrainer(
+        solver, mesh, compress="int8", overlap_avg=True,
+    )
+    profiler = profile_mod.install(profile_mod.RoundProfiler())
+    try:
+        run_loop(make_assemble(), 3, tr=comm_trainer)
+        comm_summary = profiler.summary()
+    finally:
+        profile_mod.uninstall(profiler)
+    hidden_comm = (comm_summary.get("hidden_frac_comm") or {}).get("p50")
+
+    # ---- leg 5: MFU/roofline cross-check — analytic vs XLA flops
+    from sparknet_tpu.utils.flops import train_flops
+
+    analytic_per_round = train_flops(solver.net) * tau * workers
+    from sparknet_tpu.parallel.trainers import leading_sharding
+    from sparknet_tpu.utils.rngs import train_key
+
+    state = trainer.init_state(seed=0)
+    batches = jax.device_put(window(0), leading_sharding(mesh))
+    live_placed = jax.device_put(
+        np.ones((workers,), np.float32), leading_sharding(mesh)
+    )
+    xla_per_round = _program_flops(
+        trainer._round, state, batches, train_key(0), live_placed
+    )
+    cross_ratio = (
+        analytic_per_round / xla_per_round if xla_per_round > 0 else 0.0
+    )
+    payload = anatomy.get("payload_bytes_per_round") or 0
+    intensity = analytic_per_round / payload if payload else None
+    round_p50_ms = (anatomy.get("round_ms") or {}).get("p50")
+    achieved = anatomy.get("achieved_flops_per_s")
+    mfu = anatomy.get("mfu")
+    bound = {
+        name: p["bound"] for name, p in anatomy.get("phases", {}).items()
+    }
+
+    print(
+        "profile: round %.1f ms off | %.1f ms profiled (%+.2f%%) | live "
+        "hidden h2d p50 %s (offline eff %.3f, band -%.2f: %s) | comm "
+        "hidden p50 %s | straggler seeded w%d -> detected w%s r%s "
+        "(%s) | flops analytic %.3g vs xla %.3g (ratio %.3f) | "
+        "intensity %s FLOP/B"
+        % (
+            base_s * 1e3, prof_s * 1e3, overhead_pct, hidden_p50,
+            offline_eff, hidden_band, "OK" if hidden_within else "OUT",
+            hidden_comm, straggler_worker, detected_worker,
+            detected_round, "OK" if straggler_attributed else "MISSED",
+            analytic_per_round, xla_per_round, cross_ratio,
+            round(intensity, 1) if intensity else None,
+        ),
+        file=sys.stderr,
+    )
+    out = {
+        "metric": "profile_overhead_pct",
+        "value": round(overhead_pct, 3),
+        "unit": "% of unprofiled round time",
+        # done-bar: <= 1.0, i.e. inside the 2% acceptance budget
+        # (derived from the ROUNDED value: self-consistent artifact)
+        "vs_baseline": round(round(overhead_pct, 3) / 2.0, 3),
+        "platform": jax.devices()[0].platform,
+        "workers": workers,
+        "tau": tau,
+        "batch": batch,
+        "rounds": rounds,
+        "passes": passes,
+        "anatomy_rounds": anatomy_rounds,
+        "baseline_round_ms": round(base_s * 1e3, 2),
+        "profiled_round_ms": round(prof_s * 1e3, 2),
+        "overhead_profiled_pct": round(overhead_pct, 3),
+        "phases_p50_ms": {
+            k: p["p50_ms"] for k, p in anatomy.get("phases", {}).items()
+        },
+        "round_ms_p50": round_p50_ms,
+        "hidden_frac_h2d_p50": hidden_p50,
+        "hidden_frac_h2d_max": hidden.get("max"),
+        "pipeline_overlap_efficiency": offline_eff,
+        "hidden_band": hidden_band,
+        "hidden_within_band": hidden_within,
+        "hidden_frac_comm_p50": hidden_comm,
+        "straggler_seeded_worker": straggler_worker,
+        "straggler_detected_worker": detected_worker,
+        "straggler_detected_round": detected_round,
+        "straggler_rounds": strag_rounds,
+        "straggler_skew_p50": (
+            (straggler_summary.get("worker_skew") or {}).get("p50")
+        ),
+        "healthy_skew_p50": (
+            (anatomy.get("worker_skew") or {}).get("p50")
+        ),
+        "straggler_attributed": straggler_attributed,
+        "flops_per_round_analytic": analytic_per_round,
+        "flops_per_round_xla": xla_per_round,
+        "flops_cross_check_ratio": round(cross_ratio, 4),
+        "payload_bytes_per_round": payload,
+        "arithmetic_intensity_flops_per_byte": (
+            round(intensity, 3) if intensity else None
+        ),
+        "achieved_flops_per_s": achieved,
+        "mfu": mfu,
+        "bound": bound,
+        "note": "pipelined cifar10_quick loop on the virtual dp mesh "
+        "(the bench_obs protocol).  Overhead legs are warmed + "
+        "best-of-N but on this shared 2-core box run-to-run drift is "
+        "+/-1-3% of a ~1s round while the profiler's true per-round "
+        "cost is a handful of dict/deque ops per span plus one "
+        "per-shard readiness probe that piggybacks on the sync the "
+        "loop already pays — the A/B bounds the overhead under noise "
+        "(it can measure negative).  hidden_frac_h2d is the LIVE "
+        "measured fraction of producer assemble+h2d time that ran "
+        "while the device was busy (obs/profile.py busy-window "
+        "accounting); its p50 must sit within hidden_band of "
+        "PIPELINE_r08's offline overlap_efficiency — the first "
+        "prefetch-depth rounds honestly read 0 (the feed ran ahead "
+        "before training started) and drag the min, not the p50.  "
+        "The straggler leg seeds one worker's assembly slow every "
+        "round; attribution requires the profiler's verdict to name "
+        "exactly that worker (per-phase skew — the uniform execute "
+        "probe cannot wash it out).  On the single-program virtual "
+        "CPU mesh the execute probe itself shows ~no skew (all shards "
+        "land together); per-device skew needs a real multi-queue "
+        "backend, which is why the seeded fault drives attribution "
+        "through the host-side per-worker assembly hook.  MFU is null "
+        "on CPU (no bf16 peak); flops_cross_check_ratio compares the "
+        "analytic MXU count (conv/matmul MACs at 2 FLOPs each, "
+        "backward at 2x forward) against XLA cost_analysis of the "
+        "whole compiled round — the CPU backend counts a fused "
+        "multiply-add as ONE flop and lowers the conv backward "
+        "differently, so the ratio lands in the low single digits "
+        "rather than at 1.0; the cross-check catches a broken shape "
+        "walk (orders of magnitude), not unit conventions.",
+    }
+    print(json.dumps(out))
+
+
 def main():
     if _MODE == "scaling":
         bench_scaling()
@@ -1924,6 +2245,9 @@ def main():
         return
     if _MODE == "health":
         bench_health()
+        return
+    if _MODE == "profile":
+        bench_profile()
         return
     # the remote-TPU tunnel occasionally drops a request mid-run; one
     # retry keeps the recorded benchmark from dying on a transient

@@ -156,9 +156,15 @@ def main(argv=None) -> int:
     # executes (RoundFeed; --serial_feed restores the old serial path
     # with identical numerics)
     run_obs = obs.start_from_args(args, echo=log.log)
+    # timed_worker_windows: with --profile the per-worker draw times
+    # feed the round profiler's straggler attribution (plain list
+    # comprehension otherwise)
     feed = RoundFeed(
         lambda r, out: stack_windows(
-            [s.next_window() for s in samplers], out
+            obs.profile.timed_worker_windows(
+                r, [s.next_window for s in samplers]
+            ),
+            out,
         ),
         place=lambda host: shard_leading_global(host, mesh),
         pipelined=not args.serial_feed,

@@ -125,16 +125,19 @@ def main(argv=None) -> int:
 
     def assemble(r, out):
         # reader-thread pulls + worker stack, on the RoundFeed producer:
-        # round r+1's DB reads and H2D overlap round r's execute
+        # round r+1's DB reads and H2D overlap round r's execute.
+        # worker_timer: with --profile each worker's DB pull time feeds
+        # the round profiler's straggler attribution (no-op otherwise)
         windows = []
-        for p in pipes:
-            batches = [p.next() for _ in range(args.tau)]
-            windows.append(
-                {
-                    "data": np.stack([b[0] for b in batches]),
-                    "label": np.stack([b[1] for b in batches]),
-                }
-            )
+        for w, p in enumerate(pipes):
+            with obs.profile.worker_timer(r, w, len(pipes)):
+                batches = [p.next() for _ in range(args.tau)]
+                windows.append(
+                    {
+                        "data": np.stack([b[0] for b in batches]),
+                        "label": np.stack([b[1] for b in batches]),
+                    }
+                )
         return stack_windows(windows, out)
 
     run_obs = obs.start_from_args(args, echo=log.log)

@@ -314,9 +314,14 @@ def main(argv=None) -> int:
     # into recycled buffers and device_put on a producer thread while
     # round r executes (--serial_feed restores the serial path)
     run_obs = obs.start_from_args(args, echo=log.log)
+    # timed_worker_windows: with --profile the per-worker draw times
+    # feed the round profiler's straggler attribution
     feed = RoundFeed(
         lambda r, out: stack_windows(
-            [s.next_window() for s in samplers], out
+            obs.profile.timed_worker_windows(
+                r, [s.next_window for s in samplers]
+            ),
+            out,
         ),
         place=lambda host: shard_leading_global(host, mesh),
         pipelined=not args.serial_feed,

@@ -200,15 +200,18 @@ def main(argv=None) -> int:
         return primary_accuracy(scores) / max(1, num_test_mbs)
 
     def assemble(r, out):
+        # worker_timer: with --profile each worker's DB pull time feeds
+        # the round profiler's straggler attribution (no-op otherwise)
         windows = []
-        for pipe in pipes:
-            batches = [pipe.next() for _ in range(args.tau)]
-            windows.append(
-                {
-                    "data": np.stack([b[0] for b in batches]),
-                    "label": np.stack([b[1] for b in batches]),
-                }
-            )
+        for w, pipe in enumerate(pipes):
+            with obs.profile.worker_timer(r, w, len(pipes)):
+                batches = [pipe.next() for _ in range(args.tau)]
+                windows.append(
+                    {
+                        "data": np.stack([b[0] for b in batches]),
+                        "label": np.stack([b[1] for b in batches]),
+                    }
+                )
         return stack_windows(windows, out)
 
     # pipelined feed, resume-aware: rounds are absolute, so a resumed

@@ -51,11 +51,21 @@ import jax
 import numpy as np
 
 from sparknet_tpu import obs
+from sparknet_tpu.obs import profile as obs_profile
 from sparknet_tpu.data.prefetch import (  # noqa: F401  (re-exported)
     PREFETCH_COUNT,
     Prefetcher,
     PrefetchStall,
 )
+
+
+def _host_nbytes(host) -> int:
+    """Byte size of a host batch dict (the H2D payload the h2d span
+    carries so the profiler can report achieved transfer bandwidth)."""
+    try:
+        return int(sum(int(v.nbytes) for v in host.values()))
+    except (AttributeError, TypeError):
+        return 0
 
 Assemble = Callable[[int, Optional[Dict[str, np.ndarray]]],
                     Dict[str, np.ndarray]]
@@ -163,7 +173,7 @@ class RoundFeed:
         # consumer thread's execute bar — the overlap, visually
         with obs.span("assemble", round=r):
             host = self._assemble(r, self._buf if self._recycle else None)
-        with obs.span("h2d", round=r):
+        with obs.span("h2d", round=r, nbytes=_host_nbytes(host)):
             dev = self._place(host)
             if self._recycle:
                 # the H2D copy must complete before the buffer is
@@ -218,6 +228,9 @@ class RoundFeed:
         tm = obs.training_metrics()
         if tm is not None and self._pf is not None:
             tm.feed_queue_depth.set(self._pf.qsize())
+        # the profiler keys its round records by the ABSOLUTE round the
+        # consumer is about to train on (resume replays re-key correctly)
+        obs_profile.note_consumed_round(r)
         self._next_r = r + 1
         return out
 

@@ -20,6 +20,11 @@ serving (ARCHITECTURE.md "Observability"):
   recent spans/verdicts/samples dumped as one postmortem JSON bundle
   on crash/SIGTERM/stall/halt/chaos fault (``--flight_recorder``;
   folded by ``tools/health_report.py``).
+- ``obs.profile``  — the round-anatomy profiler (``--profile``): live
+  per-phase breakdown, measured H2D/collective hidden fractions,
+  per-worker skew + straggler verdicts, MFU/roofline gauges; the live
+  counterpart of the offline PIPELINE/OBS artifacts, gated by
+  ``tools/perf_gate.py``.
 
 Instrumented code calls the module-level hooks (``obs.span``,
 ``obs.instant``, ``obs.training_metrics()``, ``obs.fault``), which are
@@ -32,6 +37,7 @@ import-light for CLI startup.)
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 import weakref
@@ -39,8 +45,10 @@ from collections import deque
 from typing import Optional
 
 from sparknet_tpu.obs import flight  # noqa: F401
+from sparknet_tpu.obs import profile as profile  # noqa: F401
 from sparknet_tpu.obs.exporter import JsonHTTPHandler, ObsExporter  # noqa: F401
 from sparknet_tpu.obs.flight import FlightRecorder  # noqa: F401
+from sparknet_tpu.obs.profile import RoundProfiler  # noqa: F401
 from sparknet_tpu.obs.metrics import (  # noqa: F401
     LATENCY_BUCKETS_S,
     Counter,
@@ -172,6 +180,53 @@ class TrainingMetrics:
             "by compression mode",
             labels=("compress",),
         )
+        self.quant_error = registry.gauge(
+            "sparknet_quant_error_max_abs",
+            "last round's max |delta - dequant(delta)| quantization "
+            "error of the compressed averaging collective, by "
+            "compression mode (parallel/comm.py delta quantization)",
+            labels=("compress",),
+        )
+        self.quant_snr_db = registry.gauge(
+            "sparknet_quant_snr_db",
+            "last round's delta-vs-quantization-error SNR in dB "
+            "(10*log10(|delta|^2/|err|^2); capped at 300 when the "
+            "error underflows to 0), by compression mode",
+            labels=("compress",),
+        )
+        # round-anatomy profiler series (obs/profile.py, --profile) —
+        # zero until a RoundProfiler is installed
+        self.hidden_fraction = registry.gauge(
+            "sparknet_hidden_fraction",
+            "measured fraction of overlap-capable work hidden under "
+            "consumer execute last round: kind=h2d (RoundFeed producer "
+            "assemble+H2D) or kind=comm (CommPlane chunked allreduce)",
+            labels=("kind",),
+        )
+        self.worker_skew = registry.gauge(
+            "sparknet_worker_skew",
+            "last round's per-worker attributed-time max/median ratio "
+            "(1.0 = homogeneous workers)",
+        )
+        self.straggler_worker = registry.gauge(
+            "sparknet_straggler_worker",
+            "dp index of the worker the profiler called a straggler "
+            "last round (-1 = none)",
+        )
+        self.straggler_rounds = registry.counter(
+            "sparknet_straggler_rounds_total",
+            "rounds whose straggler verdict fired (skew past threshold)",
+        )
+        self.achieved_flops = registry.gauge(
+            "sparknet_achieved_flops",
+            "modeled achieved FLOP/s last round (analytic utils/flops.py "
+            "MXU count / measured round wall)",
+        )
+        self.mfu = registry.gauge(
+            "sparknet_mfu",
+            "model FLOP utilization vs the chip's bf16 peak (0 when the "
+            "peak is unknown, e.g. CPU)",
+        )
         self.jit_cache = registry.gauge(
             "sparknet_jit_cache_size",
             "compiled programs behind tracked jitted fns (constant "
@@ -255,6 +310,7 @@ def _reset_training_metrics_for_tests() -> None:
         _sentry = None
         set_phase_observer(None)
     flight.uninstall()
+    profile.uninstall()
 
 
 def set_sentry(sentry) -> None:
@@ -270,6 +326,12 @@ def sentry_state() -> Optional[dict]:
     if s is None:
         return None
     return s.state_dict()
+
+
+def profile_state() -> Optional[dict]:
+    """The active round profiler's exported state (straggler verdict,
+    hidden fractions), or None — the /healthz "profile" block."""
+    return profile.state()
 
 
 def fault(kind: str, **args) -> None:
@@ -335,6 +397,20 @@ def add_cli_args(parser) -> None:
         help="sentry policy (overrides --health's value)",
     )
     parser.add_argument(
+        "--profile", action="store_true",
+        help="install the round-anatomy profiler (obs/profile.py): "
+        "live per-phase breakdown, measured H2D/collective hidden "
+        "fractions, per-worker skew + straggler verdicts, and "
+        "MFU/roofline gauges on /metrics, /healthz and the JSONL run "
+        "log; a summary table prints when the run closes",
+    )
+    parser.add_argument(
+        "--profile_out", default=None, metavar="SUMMARY.json",
+        help="write the end-of-run RoundProfiler.summary() as JSON "
+        "(implies --profile); feed it to tools/perf_gate.py --live to "
+        "compare this run against the committed baselines",
+    )
+    parser.add_argument(
         "--flight_recorder", nargs="?",
         const=flight.DEFAULT_BUNDLE_PATH, default=None,
         metavar="BUNDLE.json",
@@ -360,12 +436,17 @@ class ObsRun:
 
     def __init__(self, exporter=None, tracer=None, trace_out=None,
                  metrics: Optional[TrainingMetrics] = None,
-                 recorder: Optional[FlightRecorder] = None):
+                 recorder: Optional[FlightRecorder] = None,
+                 profiler: Optional["RoundProfiler"] = None,
+                 echo=None, profile_out: Optional[str] = None):
         self.exporter = exporter
         self.tracer = tracer
         self.trace_out = trace_out
         self.metrics = metrics
         self.recorder = recorder
+        self.profiler = profiler
+        self.profile_out = profile_out
+        self._echo = echo
         self._closed = False
 
     @property
@@ -376,6 +457,26 @@ class ObsRun:
         if self._closed:
             return
         self._closed = True
+        if self.profiler is not None:
+            # print the round-anatomy summary BEFORE tearing telemetry
+            # down — a --profile run with no tracer still gets its table
+            if self._echo is not None and self.profiler.rounds_profiled:
+                try:
+                    self._echo(profile_summary_text(self.profiler))
+                except Exception:  # noqa: BLE001 — teardown must not die
+                    pass
+            if self.profile_out:
+                try:
+                    with open(self.profile_out, "w") as f:
+                        json.dump(self.profiler.summary(), f, indent=1)
+                    if self._echo is not None:
+                        self._echo(
+                            "obs: profile summary -> %s (fold with "
+                            "tools/perf_gate.py --live)" % self.profile_out
+                        )
+                except Exception:  # noqa: BLE001 — teardown must not die
+                    pass
+            profile.uninstall(self.profiler)
         if self.exporter is not None:
             self.exporter.close()
         if self.tracer is not None:
@@ -394,29 +495,85 @@ class ObsRun:
         set_sentry(None)
 
 
+def profile_summary_text(profiler) -> str:
+    """Human one-screen rendering of a profiler summary (the --profile
+    end-of-run table)."""
+    s = profiler.summary()
+    lines = ["profile: round anatomy over %d round(s)" % s["rounds"]]
+    for name, p in s["phases"].items():
+        lines.append(
+            "  %-10s p50 %9.2f ms  p90 %9.2f ms  max %9.2f ms  [%s]"
+            % (name, p["p50_ms"], p["p90_ms"], p["max_ms"], p["bound"])
+        )
+    for key, label in (
+        ("hidden_frac_h2d", "H2D hidden fraction"),
+        ("hidden_frac_comm", "collective hidden fraction"),
+    ):
+        if s.get(key):
+            lines.append(
+                "  %s: p50 %.3f (min %.3f)"
+                % (label, s[key]["p50"], s[key]["min"])
+            )
+    if s.get("worker_skew"):
+        lines.append(
+            "  worker skew (max/median): p50 %.3f max %.3f; straggler "
+            "rounds %d%s"
+            % (
+                s["worker_skew"]["p50"], s["worker_skew"]["max"],
+                s["straggler_rounds"],
+                " (last: worker %s @ round %s)"
+                % (s["last_straggler_worker"], s["last_straggler_round"])
+                if s["last_straggler_worker"] is not None else "",
+            )
+        )
+    if s.get("achieved_flops_per_s"):
+        mfu = s.get("mfu")
+        lines.append(
+            "  achieved %.2f GFLOP/s%s"
+            % (
+                s["achieved_flops_per_s"] / 1e9,
+                "  (MFU %.2f%%)" % (100 * mfu) if mfu else
+                "  (no bf16 peak on this platform: MFU n/a)",
+            )
+        )
+    return "\n".join(lines)
+
+
 def start(
     metrics: bool = False,
     port: int = DEFAULT_OBS_PORT,
     host: str = "127.0.0.1",
     trace_out: Optional[str] = None,
     flight_out: Optional[str] = None,
+    profile_rounds: bool = False,
+    profile_out: Optional[str] = None,
     echo=print,
 ) -> ObsRun:
     """Turn telemetry on for this run: ``metrics=True`` starts the
     /metrics + /healthz sidecar; ``trace_out`` installs the tracer;
-    ``flight_out`` installs the crash flight recorder (bundle path).
-    metrics/trace also enable the training metric series (spans feed
-    the per-phase histogram).  Returns an ``ObsRun`` to ``close()`` in
-    the run's ``finally``."""
-    if not metrics and not trace_out and not flight_out:
+    ``flight_out`` installs the crash flight recorder (bundle path);
+    ``profile_rounds`` installs the round-anatomy profiler.
+    metrics/trace/profile also enable the training metric series (spans
+    feed the per-phase histogram).  Returns an ``ObsRun`` to
+    ``close()`` in the run's ``finally``."""
+    profile_rounds = profile_rounds or bool(profile_out)
+    if not metrics and not trace_out and not flight_out and not profile_rounds:
         return ObsRun()
     recorder = None
     if flight_out:
         recorder = flight.install(FlightRecorder(path=flight_out))
         if echo is not None:
             echo(f"obs: flight recorder armed -> {flight_out}")
-    if not metrics and not trace_out:
-        return ObsRun(recorder=recorder)
+    profiler = None
+    if profile_rounds:
+        profiler = profile.install(RoundProfiler())
+        if echo is not None:
+            echo(
+                "obs: round-anatomy profiler on (phase breakdown, "
+                "hidden fractions, straggler verdicts)"
+            )
+    if not metrics and not trace_out and not profile_rounds:
+        return ObsRun(recorder=recorder, echo=echo)
     tm = enable_training_metrics()
     exporter = None
     if metrics:
@@ -434,7 +591,8 @@ def start(
                 f"obs: tracing round phases -> {trace_out} "
                 f"(+ {jsonl_path_for(trace_out)})"
             )
-    return ObsRun(exporter, tracer, trace_out, tm, recorder)
+    return ObsRun(exporter, tracer, trace_out, tm, recorder, profiler, echo,
+                  profile_out=profile_out)
 
 
 def start_from_args(args, echo=print) -> ObsRun:
@@ -443,5 +601,7 @@ def start_from_args(args, echo=print) -> ObsRun:
         port=getattr(args, "obs_port", DEFAULT_OBS_PORT),
         trace_out=getattr(args, "trace_out", None),
         flight_out=getattr(args, "flight_recorder", None),
+        profile_rounds=getattr(args, "profile", False),
+        profile_out=getattr(args, "profile_out", None),
         echo=echo,
     )

@@ -81,6 +81,12 @@ class _ObsHandler(JsonHTTPHandler):
                     reason = reason or (
                         "sentry_halt: " + str(sentry.get("halt_reason"))
                     )
+            # round-anatomy block (--profile): straggler verdict +
+            # hidden fractions, so an orchestrator can tell "healthy but
+            # gated by worker 3" without scraping the full registry
+            prof = _obs.profile_state()
+            if prof is not None:
+                payload["profile"] = prof
             if reason:
                 payload.update({"status": "unhealthy", "reason": reason})
                 self._send_json(503, payload)

@@ -1,0 +1,774 @@
+"""Round anatomy: live per-phase / per-worker time attribution.
+
+The offline artifacts (PIPELINE_r08, OBS_r09, COMM_r11) prove the
+RoundFeed H2D overlap and the CommPlane chunk overlap in ``bench.py``
+A/Bs — but a *running* job had no live counterpart: the only runtime
+overlap evidence was a boolean in ``tools/trace_report.py``, per-worker
+time was invisible (the synchronous averaging round is gated by its
+slowest worker — SparkNet §4 assumes homogeneous workers), and nothing
+compared a live run against the committed trajectory.  ``RoundProfiler``
+closes that gap, per round and as rolling percentiles:
+
+- **phase breakdown** — assemble / h2d / execute / quantize / allreduce
+  / dequantize / average / snapshot, folded live from the span stream
+  (``obs/trace.py`` ``set_span_observer``; no Tracer required);
+- **measured hidden-fraction** — how much of the producer's
+  assemble+h2d time (PR 3) and of the comm thread's chunked allreduce
+  time (PR 6) actually ran *under* consumer execute spans: the live
+  counterpart of PIPELINE_r08's 0.97 offline overlap efficiency;
+- **per-worker skew + straggler verdict** — per-worker times arrive
+  from two hooks: host-side per-worker assembly timing
+  (``note_worker_phase`` / ``worker_timer`` / ``timed_worker_windows``
+  — the apps' window-draw loops and the chaos feed) and the per-shard
+  execute-readiness probe the ``ParameterAveragingTrainer`` runs after
+  each round (each dp worker's loss shard lives on its own device, so
+  the per-shard ``block_until_ready`` timestamps expose a straggling
+  device; on the single-program virtual CPU mesh all shards land
+  together — disclosed, the probe is for real multi-device queues).
+  The verdict (max/median ratio, worst-worker id) feeds ``/metrics``,
+  ``/healthz``, the JSONL run log, and the flight recorder; the chaos
+  harness's seeded ``straggler_injection`` fault must be attributed to
+  exactly the injected worker (tier-1 smoke);
+- **MFU / roofline gauges** — achieved FLOP/s from the analytic
+  ``utils/flops.py`` count (``bench.py --mode=profile`` cross-checks it
+  against ``compiled.cost_analysis()``), modeled collective payload
+  bytes from the comm plane, arithmetic intensity, and a
+  compute-vs-bandwidth-bound classification per phase.
+
+Cost discipline: inactive, every hook is one module-global read (the
+``span()`` fast path is untouched); active, a span costs a few dict/
+deque operations under a lock and the execute probe piggybacks on the
+per-round sync the driver loops already pay (``smoothed_loss``).
+``bench.py --mode=profile`` pins the end-to-end overhead under the
+PR-4/PR-5 noise-floor contract (PROFILE_r11.json).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+# phases whose per-round totals the breakdown tracks (anything else
+# still folds under its own name — this is the canonical ordering)
+PHASES = (
+    "assemble", "h2d", "execute", "quantize", "allreduce", "dequantize",
+    "average", "snapshot", "restore",
+)
+
+# roofline classification: where each phase's time goes when it
+# dominates a round.  assemble is host CPU work; h2d and the collective
+# phases move bytes; execute/average are the fused device program.
+PHASE_RESOURCE = {
+    "assemble": "host",
+    "h2d": "bandwidth",
+    "quantize": "bandwidth",
+    "allreduce": "bandwidth",
+    "dequantize": "bandwidth",
+    "execute": "compute",
+    "average": "compute",
+    "snapshot": "host",
+    "restore": "host",
+}
+
+# bf16 peak FLOP/s per device kind substring (MXU peak; public numbers;
+# mirrors bench.py's table).  CPU has no meaningful peak — MFU is None.
+_PEAK_BF16 = (
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def device_peak_flops() -> float:
+    """bf16 peak FLOP/s of device 0, or 0.0 when unknown (CPU)."""
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return 0.0
+    if "tpu" not in kind:
+        return 0.0
+    for key, peak in _PEAK_BF16:
+        if key in kind:
+            return peak
+    return 0.0
+
+
+def _overlap_s(interval, others) -> float:
+    """Seconds of ``interval`` covered by the union-ish of ``others``
+    (greedy pairwise sum clamped to the interval length — the consumer
+    execute spans never overlap each other, so pairwise is exact)."""
+    t0, t1 = interval
+    if t1 <= t0:
+        return 0.0
+    cov = 0.0
+    for o0, o1 in others:
+        lo, hi = max(t0, o0), min(t1, o1)
+        if hi > lo:
+            cov += hi - lo
+    return min(cov, t1 - t0)
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[i]
+
+
+class RoundProfiler:
+    """Folds the live span stream + per-worker timing hooks into
+    per-round phase/overlap/skew records and rolling percentiles.
+
+    Round boundaries: the feed marks the absolute round it delivers
+    (``note_consumed_round`` — RoundFeed calls it) and the
+    parameter-averaging trainer finalizes the record after each round
+    (``observe_round``).  Drivers that step the trainer without a
+    RoundFeed fall back to a consecutive internal counter."""
+
+    def __init__(
+        self,
+        *,
+        window: int = 128,
+        skew_threshold: float = 1.75,
+        skew_floor_s: float = 0.02,
+        probe_workers: bool = True,
+    ):
+        self.skew_threshold = float(skew_threshold)
+        # a worker must ALSO be this many seconds past the median to be
+        # called a straggler — max/median explodes on microsecond noise
+        self.skew_floor_s = float(skew_floor_s)
+        self.probe_workers = bool(probe_workers)
+        self._lock = threading.Lock()
+        # consumer phase seconds accumulated since the last finalize
+        self._phase_acc: Dict[str, float] = {}
+        # producer spans bucketed by the absolute round they assembled:
+        # r -> [(t0, t1)], plus their byte payloads
+        self._producer: Dict[int, List] = {}
+        self._producer_bytes: Dict[int, float] = {}
+        # comm-thread allreduce spans since the last finalize
+        self._comm_pending: List = []
+        self._comm_pending_bytes = 0.0
+        # the current round's consumer-span envelope: first dispatch t0
+        # and last span t1 since the previous finalize.  Together with
+        # the probe's drain timestamp this bounds the DEVICE-BUSY
+        # window — the overlap reference for hidden fractions.  (The
+        # execute span alone is dispatch-thin under async dispatch, so
+        # overlap against it would under-report hidden work.)
+        self._window_t0: Optional[float] = None
+        self._window_t1: Optional[float] = None
+        # recent rounds' device-busy intervals (overlap reference)
+        self._busy_intervals: deque = deque(maxlen=8)
+        self._consumer_threads: set = set()
+        # per-round per-worker seconds: r -> {phase: np.ndarray}
+        self._worker_times: Dict[int, Dict[str, np.ndarray]] = {}
+        self._consumed_round: Optional[int] = None
+        self._auto_round = 0
+        self._last_finalize_t: Optional[float] = None
+        # static per-round work, set lazily by the trainer hook
+        self.flops_per_round: Optional[float] = None
+        self.comm_bytes_per_round: Optional[float] = None
+        self.compress: str = "none"
+        self.num_workers: Optional[int] = None
+        # rolling output
+        self.rounds_profiled = 0
+        self.straggler_rounds = 0
+        self.last_straggler_worker: Optional[int] = None
+        self.last_straggler_round: Optional[int] = None
+        self._records: deque = deque(maxlen=int(window))
+        self._peak_flops = device_peak_flops()
+
+    # ------------------------------------------------------------------
+    # span stream (installed via trace.set_span_observer)
+    def on_span(self, name, cat, t0, t1, thread, args) -> None:
+        if cat not in ("phase", "comm"):
+            return
+        a = args or {}
+        with self._lock:
+            if name in ("assemble", "h2d"):
+                r = a.get("round")
+                if r is None:
+                    r = self._consumed_round if (
+                        self._consumed_round is not None
+                    ) else self._auto_round
+                if len(self._producer) >= 64:  # bounded: a driver that
+                    # never finalizes rounds must not grow this forever
+                    for k in sorted(self._producer)[:32]:
+                        self._producer.pop(k, None)
+                        self._producer_bytes.pop(k, None)
+                bucket = self._producer.setdefault(int(r), [])
+                bucket.append((t0, t1))
+                if name == "h2d" and "nbytes" in a:
+                    self._producer_bytes[int(r)] = (
+                        self._producer_bytes.get(int(r), 0.0)
+                        + float(a["nbytes"])
+                    )
+                # producer spans also count toward the phase breakdown
+                self._phase_acc[name] = (
+                    self._phase_acc.get(name, 0.0) + (t1 - t0)
+                )
+                return
+            self._phase_acc[name] = self._phase_acc.get(name, 0.0) + (t1 - t0)
+            if name in ("execute", "average", "quantize", "dequantize"):
+                # consumer-side spans bound the round's dispatch window
+                if self._window_t0 is None or t0 < self._window_t0:
+                    self._window_t0 = t0
+                if self._window_t1 is None or t1 > self._window_t1:
+                    self._window_t1 = t1
+                if name in ("execute", "average"):
+                    self._consumer_threads.add(thread)
+            if name == "allreduce":
+                if len(self._comm_pending) < 512:  # bounded like above
+                    self._comm_pending.append((t0, t1, thread))
+                self._comm_pending_bytes += float(a.get("nbytes", 0.0))
+
+    # ------------------------------------------------------------------
+    # per-worker timing hooks (host side)
+    def note_worker_phase(self, r: int, phase: str, seconds) -> None:
+        """Record per-worker seconds for ``phase`` of absolute round
+        ``r`` — ``seconds`` is indexable by worker (list/ndarray).  The
+        chaos feed and the apps' window-draw loops call this with their
+        measured per-worker assembly times."""
+        arr = np.asarray(seconds, np.float64).reshape(-1)
+        with self._lock:
+            if len(self._worker_times) >= 64:  # bounded like _producer
+                for k in sorted(self._worker_times)[:32]:
+                    self._worker_times.pop(k, None)
+            self._worker_times.setdefault(int(r), {})[phase] = arr
+
+    def note_worker_time(self, r: int, phase: str, worker: int,
+                         seconds: float, num_workers: int) -> None:
+        """Single-worker variant of ``note_worker_phase`` (the
+        ``worker_timer`` context manager feeds this)."""
+        with self._lock:
+            bucket = self._worker_times.setdefault(int(r), {})
+            arr = bucket.get(phase)
+            if arr is None or arr.shape[0] < num_workers:
+                new = np.zeros((num_workers,), np.float64)
+                if arr is not None:
+                    new[: arr.shape[0]] = arr
+                arr = bucket[phase] = new
+            arr[int(worker)] += float(seconds)
+
+    # ------------------------------------------------------------------
+    # feed + trainer hooks
+    def note_consumed_round(self, r: int) -> None:
+        """The feed delivered absolute round ``r``'s batch to the
+        consumer — the next ``observe_round`` finalizes under this
+        index (RoundFeed calls this; resume replays re-key correctly)."""
+        self._consumed_round = int(r)
+
+    def note_round_work(
+        self,
+        flops_per_round: Optional[float] = None,
+        comm_bytes_per_round: Optional[float] = None,
+        compress: Optional[str] = None,
+        num_workers: Optional[int] = None,
+    ) -> None:
+        """Static per-round work sizes (trainer hook, set once)."""
+        if flops_per_round is not None:
+            self.flops_per_round = float(flops_per_round)
+        if comm_bytes_per_round is not None:
+            self.comm_bytes_per_round = float(comm_bytes_per_round)
+        if compress is not None:
+            self.compress = compress
+        if num_workers is not None:
+            self.num_workers = int(num_workers)
+
+    def probe_execute(self, out) -> Optional[np.ndarray]:
+        """Per-worker execute-completion probe: time each dp shard of a
+        round output (losses) becoming ready.  Returns per-worker
+        seconds since the probe started, or None when the array has no
+        per-worker shards.  Polls ``is_ready`` so a fast worker's
+        completion is stamped while a straggler still runs (on a real
+        multi-device queue; the single-program virtual CPU mesh lands
+        all shards together — disclosed in PROFILE_r11).  The probe is
+        the profiler's one deliberate per-round sync — the driver loops
+        already sync each round (``smoothed_loss``), so it mostly moves
+        the wait rather than adding one."""
+        import jax
+
+        try:
+            shards = list(out.addressable_shards)
+        except Exception:
+            return None
+        if len(shards) < 2:
+            return None
+
+        def worker_of(s):
+            idx = s.index[0]
+            return int(idx.start or 0) if isinstance(idx, slice) else 0
+
+        t0 = time.perf_counter()
+        times: Dict[int, float] = {}
+        pending = {}
+        for s in shards:
+            w = worker_of(s)
+            if w in pending:
+                # replicated (or non-leading-sharded) output: every
+                # shard maps to the same worker row, so there is no
+                # per-worker completion to time — bail BEFORE polling
+                # (polling would add a per-round sync for nothing)
+                return None
+            pending[w] = s.data
+        can_poll = all(hasattr(d, "is_ready") for d in pending.values())
+        while pending:
+            done = []
+            for w, d in pending.items():
+                if not can_poll:
+                    jax.block_until_ready(d)
+                if not can_poll or d.is_ready():
+                    times[w] = time.perf_counter() - t0
+                    done.append(w)
+            for w in done:
+                pending.pop(w)
+            if pending:
+                time.sleep(0.001)
+        n = max(times) + 1
+        arr = np.zeros((n,), np.float64)
+        for w, dt in times.items():
+            arr[w] = dt
+        return arr
+
+    def observe_round(self, losses=None) -> Optional[dict]:
+        """Finalize the round that just completed: fold the phase
+        accumulator, compute hidden fractions, run the execute probe,
+        emit the verdict (metrics gauges + run-log instant + flight
+        ring).  The parameter-averaging trainer calls this once per
+        round; returns the round record."""
+        probe = None
+        if self.probe_workers and losses is not None:
+            probe = self.probe_execute(losses)
+        probe_end = time.perf_counter()  # the device is drained now
+        r = self._consumed_round
+        if r is None:
+            r = self._auto_round
+        now = probe_end
+        with self._lock:
+            self._auto_round = r + 1
+            self._consumed_round = None
+            phases = {k: v for k, v in self._phase_acc.items()}
+            self._phase_acc = {}
+            # --- this round's DEVICE-BUSY window: first consumer-span
+            # dispatch to the probe's drain point (without a probe, the
+            # last consumer span end — dispatch-thin under async
+            # dispatch, disclosed).  The rolling deque of recent busy
+            # windows is the overlap reference for both hidden fracs.
+            if self._window_t0 is not None:
+                t1 = self._window_t1 or self._window_t0
+                if probe is not None:
+                    t1 = max(t1, probe_end)
+                self._busy_intervals.append((self._window_t0, t1))
+            self._window_t0 = None
+            self._window_t1 = None
+            busy = list(self._busy_intervals)
+            # --- producer (RoundFeed) hidden fraction for THIS round's
+            # batch: how much of its assemble+h2d time ran while the
+            # device was busy with earlier rounds (round 0 and the
+            # serial feed naturally read 0 — nothing was executing)
+            prod = self._producer.pop(r, [])
+            # drop buckets that can never finalize (feed restarted far
+            # back, or rounds consumed without producer spans)
+            for stale in [k for k in self._producer if k < r - 8]:
+                self._producer.pop(stale, None)
+                self._producer_bytes.pop(stale, None)
+            h2d_bytes = self._producer_bytes.pop(r, 0.0)
+            prod_total = sum(t1 - t0 for t0, t1 in prod)
+            prod_hidden = sum(_overlap_s(iv, busy) for iv in prod)
+            hidden_h2d = (
+                prod_hidden / prod_total if prod_total > 0 else None
+            )
+            # --- comm (CommPlane) hidden fraction: allreduce spans on a
+            # non-consumer thread (the overlap mode's comm thread)
+            # overlapping device-busy windows; spans on the consumer
+            # thread are the barriered collective — visible by
+            # definition, hidden fraction 0
+            comm = self._comm_pending
+            self._comm_pending = []
+            comm_bytes = self._comm_pending_bytes
+            self._comm_pending_bytes = 0.0
+            comm_total = sum(t1 - t0 for t0, t1, _ in comm)
+            comm_off_thread = [
+                (t0, t1) for t0, t1, thr in comm
+                if thr not in self._consumer_threads
+            ]
+            comm_hidden = sum(_overlap_s(iv, busy) for iv in comm_off_thread)
+            hidden_comm = (
+                comm_hidden / comm_total if comm_total > 0 else None
+            )
+            # --- per-worker attribution
+            wt = self._worker_times.pop(r, {})
+            for stale in [k for k in self._worker_times if k < r - 8]:
+                self._worker_times.pop(stale, None)
+            round_s = (
+                now - self._last_finalize_t
+                if self._last_finalize_t is not None
+                else None
+            )
+            self._last_finalize_t = now
+        if probe is not None:
+            wt = dict(wt, execute_probe=probe)
+        worker = self._worker_verdict(r, wt)
+        rec = {
+            "round": int(r),
+            "round_s": round_s,
+            "phases_ms": {
+                k: round(v * 1e3, 3) for k, v in sorted(phases.items())
+            },
+            "hidden_frac_h2d": hidden_h2d,
+            "hidden_frac_comm": hidden_comm,
+            "producer_ms": round(prod_total * 1e3, 3),
+            "comm_ms": round(comm_total * 1e3, 3),
+            "h2d_bytes": h2d_bytes,
+            "comm_chunk_bytes": comm_bytes,
+            "worker": worker,
+        }
+        if self.flops_per_round and round_s:
+            rec["achieved_flops_per_s"] = self.flops_per_round / round_s
+            rec["mfu"] = (
+                rec["achieved_flops_per_s"] / self._peak_flops
+                if self._peak_flops > 0
+                else None
+            )
+        with self._lock:
+            self._records.append(rec)
+            self.rounds_profiled += 1
+        self._export(rec)
+        return rec
+
+    # ------------------------------------------------------------------
+    def _worker_verdict(self, r: int, wt: Dict[str, np.ndarray]):
+        """Fold per-worker phase times into the skew/straggler verdict.
+        Skew is judged PER PHASE (max/median over workers, plus an
+        absolute max-median floor) — a worker straggling in one phase
+        must not be washed out by a phase that is uniformly large
+        (e.g. a slow host partition's assembly under a long execute)."""
+        if not wt:
+            return None
+        n = max(a.shape[0] for a in wt.values())
+        total = np.zeros((n,), np.float64)
+        per_phase = {}
+        worst_phase = None
+        for phase, arr in sorted(wt.items()):
+            total[: arr.shape[0]] += arr
+            if arr.shape[0] < 2:
+                continue
+            med = float(np.median(arr))
+            mx = float(np.max(arr))
+            skew = mx / med if med > 0 else float("inf") if mx > 0 else 1.0
+            gap = mx - med
+            flags = bool(skew > self.skew_threshold and gap > self.skew_floor_s)
+            per_phase[phase] = {
+                "skew": round(skew, 3) if np.isfinite(skew) else None,
+                "worst_worker": int(np.argmax(arr)),
+                "straggler": flags,
+            }
+            if flags and (worst_phase is None or gap > worst_phase[1]):
+                worst_phase = (phase, gap)
+        med = float(np.median(total))
+        mx = float(np.max(total))
+        skew = mx / med if med > 0 else float("inf") if mx > 0 else 1.0
+        if worst_phase is not None:
+            culprit = per_phase[worst_phase[0]]
+            worst = culprit["worst_worker"]
+            straggler = True
+            straggler_phase = worst_phase[0]
+            # headline skew: the straggling phase's ratio (the total can
+            # wash it out under a uniformly large phase)
+            if culprit["skew"] is not None:
+                skew = max(skew, culprit["skew"])
+        else:
+            worst = int(np.argmax(total))
+            straggler = bool(
+                skew > self.skew_threshold and (mx - med) > self.skew_floor_s
+            )
+            straggler_phase = None
+        if straggler:
+            with self._lock:
+                self.straggler_rounds += 1
+                self.last_straggler_worker = worst
+                self.last_straggler_round = int(r)
+        return {
+            "times_ms": [round(v * 1e3, 3) for v in total],
+            "phases": sorted(wt),
+            "per_phase": per_phase,
+            "skew": round(skew, 3) if np.isfinite(skew) else None,
+            "worst_worker": worst,
+            "straggler": straggler,
+            "straggler_phase": straggler_phase,
+        }
+
+    def _export(self, rec: dict) -> None:
+        """One verdict per round to the shared registry, the JSONL run
+        log, and the flight ring (``obs.instant`` feeds both)."""
+        from sparknet_tpu import obs as _obs
+
+        tm = _obs.training_metrics()
+        if tm is not None:
+            if rec["hidden_frac_h2d"] is not None:
+                tm.hidden_fraction.labels("h2d").set(rec["hidden_frac_h2d"])
+            if rec["hidden_frac_comm"] is not None:
+                tm.hidden_fraction.labels("comm").set(rec["hidden_frac_comm"])
+            w = rec["worker"]
+            if w is not None and w["skew"] is not None:
+                tm.worker_skew.set(w["skew"])
+                tm.straggler_worker.set(
+                    w["worst_worker"] if w["straggler"] else -1
+                )
+                if w["straggler"]:
+                    tm.straggler_rounds.inc()
+            if rec.get("achieved_flops_per_s"):
+                tm.achieved_flops.set(rec["achieved_flops_per_s"])
+                if rec.get("mfu") is not None:
+                    tm.mfu.set(rec["mfu"])
+        args = {
+            "round": rec["round"],
+            "hidden_h2d": rec["hidden_frac_h2d"],
+            "hidden_comm": rec["hidden_frac_comm"],
+        }
+        w = rec["worker"]
+        if w is not None:
+            args.update(
+                skew=w["skew"], worst_worker=w["worst_worker"],
+                straggler=w["straggler"],
+            )
+        _obs.instant("profile", cat="profile", **args)
+
+    # ------------------------------------------------------------------
+    def last(self) -> Optional[dict]:
+        with self._lock:
+            return self._records[-1] if self._records else None
+
+    def summary(self) -> dict:
+        """Rolling percentiles over the record window: the live profile
+        a driver prints / the perf gate consumes."""
+        with self._lock:
+            recs = list(self._records)
+        phase_names = sorted({k for r in recs for k in r["phases_ms"]})
+        phases = {}
+        for name in phase_names:
+            vals = sorted(
+                r["phases_ms"][name] for r in recs if name in r["phases_ms"]
+            )
+            phases[name] = {
+                "count": len(vals),
+                "p50_ms": round(_pct(vals, 0.50), 3),
+                "p90_ms": round(_pct(vals, 0.90), 3),
+                "max_ms": round(vals[-1], 3) if vals else 0.0,
+                "bound": PHASE_RESOURCE.get(name, "host"),
+            }
+        def frac_stats(key):
+            vals = sorted(
+                r[key] for r in recs if r.get(key) is not None
+            )
+            if not vals:
+                return None
+            return {
+                "p50": round(_pct(vals, 0.5), 4),
+                "min": round(vals[0], 4),
+                "max": round(vals[-1], 4),
+            }
+
+        skews = sorted(
+            r["worker"]["skew"] for r in recs
+            if r.get("worker") and r["worker"]["skew"] is not None
+        )
+        rounds_s = sorted(
+            r["round_s"] for r in recs if r.get("round_s") is not None
+        )
+        flops = self.flops_per_round
+        payload = self.comm_bytes_per_round
+        out = {
+            "rounds": len(recs),
+            "phases": phases,
+            "hidden_frac_h2d": frac_stats("hidden_frac_h2d"),
+            "hidden_frac_comm": frac_stats("hidden_frac_comm"),
+            "round_ms": {
+                "p50": round(_pct(rounds_s, 0.5) * 1e3, 2),
+                "max": round(rounds_s[-1] * 1e3, 2),
+            } if rounds_s else None,
+            "worker_skew": {
+                "p50": round(_pct(skews, 0.5), 3),
+                "max": round(skews[-1], 3),
+            } if skews else None,
+            "straggler_rounds": self.straggler_rounds,
+            # window-scoped count: straggler verdicts among the recs
+            # above (straggler_rounds is the LIFETIME counter and can
+            # exceed len(recs) once the deque wraps — consumers judging
+            # "standing straggler" must use the windowed number)
+            "straggler_rounds_window": sum(
+                1 for rr in recs
+                if rr.get("worker") and rr["worker"]["straggler"]
+            ),
+            "last_straggler_worker": self.last_straggler_worker,
+            "last_straggler_round": self.last_straggler_round,
+            "flops_per_round": flops,
+            "payload_bytes_per_round": payload,
+            "compress": self.compress,
+        }
+        if flops and rounds_s:
+            ach = flops / _pct(rounds_s, 0.5)
+            out["achieved_flops_per_s"] = ach
+            out["mfu"] = (
+                round(ach / self._peak_flops, 6)
+                if self._peak_flops > 0 else None
+            )
+        if flops and payload:
+            out["arithmetic_intensity_flops_per_byte"] = round(
+                flops / payload, 3
+            )
+        return out
+
+    def state_dict(self) -> dict:
+        """The /healthz profile block: enough for an orchestrator to
+        see 'round anatomy healthy' vs 'worker 3 is straggling'."""
+        last = self.last()
+        w = last.get("worker") if last else None
+        return {
+            "rounds_profiled": self.rounds_profiled,
+            "straggler_rounds": self.straggler_rounds,
+            "last_straggler_worker": self.last_straggler_worker,
+            "last_straggler_round": self.last_straggler_round,
+            "last_skew": w["skew"] if w else None,
+            "last_worst_worker": w["worst_worker"] if w else None,
+            "last_hidden_frac_h2d": (
+                last.get("hidden_frac_h2d") if last else None
+            ),
+            "last_hidden_frac_comm": (
+                last.get("hidden_frac_comm") if last else None
+            ),
+        }
+
+
+# ----------------------------------------------------------------------
+# module-level install surface (the obs pattern: hooks are near-free
+# no-ops until a profiler is installed)
+
+_active: Optional[RoundProfiler] = None
+
+
+def install(profiler: RoundProfiler) -> RoundProfiler:
+    """Make ``profiler`` the process's active round profiler: span
+    completions and the worker-timing hooks feed it.  One at a time."""
+    global _active
+    _active = profiler
+    from sparknet_tpu.obs import trace as _trace
+
+    _trace.set_span_observer(profiler.on_span)
+    return profiler
+
+
+def uninstall(profiler: Optional[RoundProfiler] = None) -> None:
+    global _active
+    if profiler is not None and profiler is not _active:
+        return
+    _active = None
+    from sparknet_tpu.obs import trace as _trace
+
+    _trace.set_span_observer(None)
+
+
+def active() -> Optional[RoundProfiler]:
+    return _active
+
+
+def note_consumed_round(r: int) -> None:
+    p = _active
+    if p is not None:
+        p.note_consumed_round(r)
+
+
+def note_worker_phase(r: int, phase: str, seconds) -> None:
+    p = _active
+    if p is not None:
+        p.note_worker_phase(r, phase, seconds)
+
+
+class _WorkerTimer:
+    __slots__ = ("r", "phase", "worker", "num_workers", "_t0")
+
+    def __init__(self, r, phase, worker, num_workers):
+        self.r, self.phase = r, phase
+        self.worker, self.num_workers = worker, num_workers
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        p = _active
+        if p is not None:
+            p.note_worker_time(
+                self.r, self.phase, self.worker,
+                time.perf_counter() - self._t0, self.num_workers,
+            )
+        return False
+
+
+class _NullTimer:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_TIMER = _NullTimer()
+
+
+def worker_timer(r: int, worker: int, num_workers: int,
+                 phase: str = "assemble"):
+    """Context manager attributing a block of host work to one worker
+    of one absolute round (no-op when no profiler is installed) — the
+    per-worker assembly hook the db apps wrap their reader loops in."""
+    if _active is None:
+        return _NULL_TIMER
+    return _WorkerTimer(r, phase, worker, num_workers)
+
+
+def timed_worker_windows(r: int, draws) -> list:
+    """Draw one window per worker, timing each draw: ``draws`` is a
+    sequence of zero-arg callables (e.g. ``[s.next_window for s in
+    samplers]``).  With a profiler installed the per-worker seconds are
+    recorded as round ``r``'s assemble attribution; without one this is
+    exactly the plain list comprehension."""
+    if _active is None:
+        return [d() for d in draws]
+    times = []
+    out = []
+    for d in draws:
+        t0 = time.perf_counter()
+        out.append(d())
+        times.append(time.perf_counter() - t0)
+    note_worker_phase(r, "assemble", times)
+    return out
+
+
+def observe_round_if_active(losses=None) -> None:
+    """Finalize a profiled round (no-op without a profiler) — the
+    step-shaped trainers (AllReduce, bare Solver) call this so
+    ``--profile`` rounds finalize on every training path."""
+    p = _active
+    if p is not None:
+        p.observe_round(losses)
+
+
+def state() -> Optional[dict]:
+    """The active profiler's exported state, or None (the /healthz
+    block)."""
+    p = _active
+    if p is None:
+        return None
+    return p.state_dict()

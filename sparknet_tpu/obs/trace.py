@@ -166,6 +166,12 @@ _tracer: Optional[Tracer] = None
 # observes (name, dur_s) of phase-cat spans into the metrics layer when
 # training metrics are enabled (set by obs/__init__; None = off)
 _phase_observer = None
+# observes EVERY completed span with its interval and thread —
+# fn(name, cat, t0_s, t1_s, thread_name, args) where t0/t1 are
+# perf_counter values (comparable across threads in one process).  The
+# RoundProfiler (obs/profile.py) installs itself here to fold the span
+# stream into per-round phase/overlap accounting; None = off
+_span_observer = None
 # the installed FlightRecorder's event ring (obs/flight.py; None = off)
 # — spans/instants feed it even when no Tracer is recording
 _flight = None
@@ -190,6 +196,14 @@ def get_tracer() -> Optional[Tracer]:
 def set_phase_observer(fn) -> None:
     global _phase_observer
     _phase_observer = fn
+
+
+def set_span_observer(fn) -> None:
+    """Point span() completions at a profiler (obs/profile.py owns the
+    install/uninstall lifecycle).  ``fn(name, cat, t0_s, t1_s,
+    thread_name, args)`` runs on the thread that closed the span."""
+    global _span_observer
+    _span_observer = fn
 
 
 def set_flight(recorder) -> None:
@@ -247,6 +261,12 @@ class _Span:
         obs = _phase_observer
         if obs is not None and self.cat == "phase":
             obs(self.name, dur_s)
+        so = _span_observer
+        if so is not None:
+            so(
+                self.name, self.cat, self._t0, t1,
+                threading.current_thread().name, self.args,
+            )
         return False
 
 
@@ -255,7 +275,12 @@ def span(name: str, cat: str = "phase", **args):
     also feed the per-phase latency histogram when training metrics are
     enabled.  Near-free when tracing, metrics AND flight recording are
     off."""
-    if _tracer is None and _phase_observer is None and _flight is None:
+    if (
+        _tracer is None
+        and _phase_observer is None
+        and _flight is None
+        and _span_observer is None
+    ):
         return _NULL_SPAN
     return _Span(name, cat, args or None)
 

@@ -218,6 +218,7 @@ class CommPlane:
         self._modes_static: Tuple[str, ...] = ()
         self._payload_bytes_per_round = 0  # modeled, set at _setup
         self._pending = None  # in-flight overlapped round
+        self._pending_err = None  # dispatched quant-error readout
 
         audit = self.audit
         mask_nf = self.mask_nonfinite
@@ -282,11 +283,19 @@ class CommPlane:
                 return q.astype(jnp.float32)
             return q  # fp32
 
-        def encode_fn(leaves, anchors, resids, modes_idx):
+        def encode_fn(leaves, anchors, resids, modes_idx, with_err):
             # delta = theta_end - anchor (+ error-feedback residual);
             # quantize per tensor.  Pure per-worker compute: GSPMD
-            # keeps every op local to the worker's shard.
+            # keeps every op local to the worker's shard.  with_err
+            # (static) additionally folds the quantization-error
+            # readout (max |err|, |delta|^2, |err|^2 for the live SNR
+            # gauge) into the SAME program — the residual IS the error,
+            # so the reductions fuse with work already being done
+            # instead of paying a second full-model dequant pass.
             qs, scales, new_resids = [], [], []
+            max_abs = jnp.zeros(())
+            err_sq = jnp.zeros(())
+            delta_sq = jnp.zeros(())
             for x, a, r, mi in zip(leaves, anchors, resids, modes_idx):
                 mode = self._modes_static[mi]
                 delta = (x - a) + r
@@ -310,10 +319,16 @@ class CommPlane:
                     scale = zero_scale
                 qs.append(q)
                 scales.append(scale)
-                new_resids.append(delta - _dequant(q, scale, mode))
-            return tuple(qs), tuple(scales), tuple(new_resids)
+                err = delta - _dequant(q, scale, mode)
+                new_resids.append(err)
+                if with_err:
+                    max_abs = jnp.maximum(max_abs, jnp.max(jnp.abs(err)))
+                    err_sq = err_sq + jnp.sum(jnp.square(err))
+                    delta_sq = delta_sq + jnp.sum(jnp.square(delta))
+            err_out = (max_abs, delta_sq, err_sq) if with_err else None
+            return tuple(qs), tuple(scales), tuple(new_resids), err_out
 
-        self._encode = jax.jit(encode_fn, static_argnums=(3,))
+        self._encode = jax.jit(encode_fn, static_argnums=(3, 4))
 
         def allreduce_fn(qs, scales, alive, modes_idx):
             # masked mean of the dequantized deltas over the dp axis.
@@ -451,6 +466,7 @@ class CommPlane:
             except Exception:  # pragma: no cover - defensive
                 pass
         self._pending = None
+        self._pending_err = None
         self._anchor = None
         if self._resid is not None:
             self._resid = [jnp.zeros_like(r) for r in self._resid]
@@ -549,12 +565,40 @@ class CommPlane:
             return self._local(state, batches, rng, live)
 
     # ------------------------------------------------------------------
+    def flush_quant_error(self) -> Optional[dict]:
+        """Land the previous round's dispatched quantization-error
+        readout into the gauges (values are ready by now — no stall).
+        Returns the readout dict, or None when nothing is pending."""
+        pending = self._pending_err
+        if pending is None:
+            return None
+        self._pending_err = None
+        from sparknet_tpu import obs as _obs
+
+        max_abs, delta_sq, err_sq = (
+            float(v) for v in jax.device_get(pending)
+        )
+        if err_sq > 0:
+            snr_db = 10.0 * float(np.log10(max(delta_sq, 1e-45) / err_sq))
+        else:
+            snr_db = 300.0  # error underflowed to exactly 0
+        tm = _obs.training_metrics()
+        if tm is not None:
+            tm.quant_error.labels(self.compress).set(max_abs)
+            tm.quant_snr_db.labels(self.compress).set(round(snr_db, 3))
+        return {
+            "compress": self.compress,
+            "max_abs_err": max_abs,
+            "snr_db": round(snr_db, 3),
+        }
+
     def round(self, state, batches, rng, live, live_host):
         """One comm-plane averaging round.  ``live`` is the placed
         (num_workers,) mask, ``live_host`` its host value.  Returns the
         fused round's contract: ``(state, losses[, astats])``."""
         if self._treedefs is None:
             self._setup(state)
+        self.flush_quant_error()  # last round's readout (ready: no sync)
 
         tau = jax.tree_util.tree_leaves(batches)[0].shape[1]
         astats = None
@@ -601,18 +645,34 @@ class CommPlane:
         # ---- encode this round's deltas ----
         leaves = self._comm_leaves(state)
         idx = tuple(range(len(leaves)))
+        # per-round quantization-error telemetry (delta max-abs-err +
+        # SNR, labeled by compress mode like the payload family): the
+        # PR-6 bit-accuracy band, observable in LIVE runs.  The
+        # 3-scalar readout is folded into the encode program itself
+        # (static with_err leg — the residual IS the error, so the
+        # reductions fuse with work already being done) and fetched one
+        # round later by flush_quant_error, so the gauge never adds a
+        # sync or a second model pass to the dispatch path.
+        # compress="none" (the overlap-only plane) quantizes nothing —
+        # skip the readout entirely; fp32 keeps its deliberate
+        # exactly-zero/300 dB export (pinned in test_comm) as the
+        # bit-accuracy control.
+        tm = obs.training_metrics()
+        with_err = tm is not None and self.compress != "none"
         with obs.span("quantize", compress=self.compress):
-            q, scales, new_resid = self._encode(
-                tuple(leaves), tuple(self._anchor), tuple(self._resid), idx
+            q, scales, new_resid, err = self._encode(
+                tuple(leaves), tuple(self._anchor), tuple(self._resid),
+                idx, with_err,
             )
         q, scales = list(q), list(scales)
         self._resid = list(new_resid)
 
-        tm = obs.training_metrics()
         if tm is not None:
             tm.collective_bytes.labels(self.compress).inc(
                 self._payload_bytes_per_round
             )
+            if with_err:
+                self._pending_err = err
 
         # Overlap only on the all-alive path: a masked/dead worker
         # forces the strict barriered apply (consensus overwrite,
@@ -668,6 +728,7 @@ class CommPlane:
         """Land the in-flight overlapped collective into ``state`` —
         call before an eval or at the end of training so the last
         round's average is applied.  No-op when nothing is pending."""
+        self.flush_quant_error()  # the last round's gauges land too
         if self._pending is None:
             return state
         self._join_pending()

@@ -22,6 +22,7 @@ multi-host pod (see ``mesh.initialize_distributed``).
 
 from __future__ import annotations
 
+import weakref
 from typing import Dict, Optional
 
 import jax
@@ -35,6 +36,7 @@ except AttributeError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
 from sparknet_tpu import obs
+from sparknet_tpu.obs import profile as obs_profile
 from sparknet_tpu.solver import Solver, TrainState
 from sparknet_tpu.utils.rngs import train_key
 
@@ -534,10 +536,55 @@ class ParameterAveragingTrainer:
         if tm is not None:
             tm.rounds.inc()
             tm.iters.inc(losses.shape[-1])  # tau (shape read: no sync)
+        prof = obs_profile.active()
+        if prof is not None:
+            # round-anatomy profiler (--profile): static work sizes once,
+            # then the per-shard execute probe + round finalize.  Outside
+            # the average span so the probe's sync never inflates it.
+            self._note_profile_work(prof, int(losses.shape[-1]), state)
+            prof.observe_round(losses)
         obs.report_healthy()  # a completed round clears /healthz
         if self.audit:
             return state, losses, astats
         return state, losses
+
+    def _note_profile_work(self, prof, tau: int, state) -> None:
+        """Hand the profiler this trainer's modeled per-round work: MXU
+        FLOPs (analytic shape walk) and collective payload bytes (comm
+        plane when engaged, else the fused fp32 model)."""
+        # memo: a WEAKREF to the noting trainer lives on the profiler —
+        # id()-based keys on either side collide when a fresh object
+        # recycles a freed address, silently starving the new one of
+        # its work sizes
+        noted = getattr(prof, "_work_noted_by", None)
+        if noted is not None and noted[0]() is self and noted[1] == tau:
+            return
+        prof._work_noted_by = (weakref.ref(self), tau)
+        flops = None
+        try:
+            from sparknet_tpu.utils.flops import train_flops
+
+            flops = train_flops(self.solver.net) * tau * self.num_workers
+        except Exception:  # a net without static shapes stays unmodeled
+            pass
+        if self._comm is not None:
+            payload = self._comm.payload_bytes_per_round or None
+            compress = self._comm.compress
+        else:
+            if self._fused_payload_bytes is None and self.average_params:
+                from sparknet_tpu.parallel import comm as _comm
+
+                self._fused_payload_bytes = _comm.fused_round_payload_bytes(
+                    state, self.average_stats
+                )
+            payload = self._fused_payload_bytes
+            compress = "none"
+        prof.note_round_work(
+            flops_per_round=flops,
+            comm_bytes_per_round=payload,
+            compress=compress,
+            num_workers=self.num_workers,
+        )
 
     def finalize(self, state: TrainState) -> TrainState:
         """Land any in-flight overlapped averaging collective into
@@ -702,6 +749,10 @@ class AllReduceTrainer:
         if tm is not None:
             tm.rounds.inc()
             tm.iters.inc(losses.shape[0])  # tau (shape read: no sync)
+        # --profile: finalize the profiled round (losses are replicated
+        # here, so no per-worker shard probe — phases/skew come from the
+        # span stream and the feed's worker hooks)
+        obs_profile.observe_round_if_active(losses)
         obs.report_healthy()
         if audit:
             return state, losses, stats

@@ -24,6 +24,12 @@ real TPU pod into a small cifar10_quick run on the virtual mesh —
   seeded round; the numerics audit (``obs/health.py``) must flag that
   EXACT round and the in-graph sentry mask must exclude the poisoned
   replica from the parameter average before it reaches the ``psum``.
+- **straggler injection**: one dp worker's batch assembly sleeps at a
+  seeded round (a slow host / degraded chip stand-in); the round-
+  anatomy profiler (``obs/profile.py``) must attribute the slow round
+  to EXACTLY the seeded worker (per-worker timing hooks + straggler
+  verdict) — the signal ROADMAP item 1's elastic membership needs to
+  know *which* worker to evict.
 
 Every fault is counted as injected and (when the run recovers) survived;
 ``bench.py --mode=chaos`` emits the ``CHAOS_r07.json`` artifact
@@ -45,6 +51,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from sparknet_tpu import obs as _obs
+from sparknet_tpu.obs import profile as _profile
 from sparknet_tpu.utils import retry as _retry
 
 
@@ -88,6 +95,17 @@ class FaultPlan:
     # analog of the dead-worker fault.
     nan_round: Optional[int] = 2
     nan_workers: Tuple[int, ...] = (1,)
+    # straggler_injection: this dp worker's batch assembly sleeps
+    # straggler_s at this round (fires once, by absolute round index).
+    # The round profiler's per-worker attribution must name EXACTLY
+    # this worker (worst_worker + straggler verdict).  Before the
+    # preemption so the resume replay cannot re-fire it; a different
+    # worker than the nan/dead ones so each fault's attribution is
+    # unambiguous.  Kept well under stall_timeout_s: the straggler must
+    # not trip the feed watchdog (that is the stall fault's job).
+    straggler_round: Optional[int] = 1
+    straggler_worker: int = 3
+    straggler_s: float = 0.4
 
     @classmethod
     def default(cls) -> "FaultPlan":
@@ -103,6 +121,7 @@ class FaultPlan:
             corrupt_newest=False,
             dead_worker=None,
             nan_round=None,
+            straggler_round=None,
         )
 
 
@@ -177,9 +196,14 @@ class _Feed:
             "nans",
             set() if plan.nan_round is None else {plan.nan_round},
         )
+        fault_state.setdefault(
+            "stragglers",
+            set() if plan.straggler_round is None else {plan.straggler_round},
+        )
         self._faults = fault_state["faults"]
         self._stalls = fault_state["stalls"]
         self._nans = fault_state["nans"]
+        self._stragglers = fault_state["stragglers"]
         self._rf = None
         self._policy = _retry.RetryPolicy(
             max_attempts=6, base_s=0.005, cap_s=0.02, budget_s=2.0
@@ -188,13 +212,39 @@ class _Feed:
     def _build(self, r: int):
         p, W, tau, B = self.plan, self.plan.workers, self.plan.tau, self.plan.batch
         n = len(self.xs)
+        straggle = None
+        if r in self._stragglers:
+            # straggler_injection: the planned worker's assembly sleeps
+            # — a slow host partition / degraded chip stand-in.  The
+            # per-worker timing hook below attributes it; the round
+            # profiler's verdict must name exactly this worker.
+            self._stragglers.discard(r)
+            straggle = self.plan.straggler_worker
+            self.counters["straggler_injected"] = (
+                self.counters.get("straggler_injected", 0) + 1
+            )
+            self.events.append(
+                "round %d: worker %d straggles %.2fs in assembly"
+                % (r, straggle, self.plan.straggler_s)
+            )
+            _obs.fault(
+                "straggler_injection", round=r, worker=straggle,
+                straggler_s=self.plan.straggler_s,
+            )
         data = np.empty((W, tau) + self.xs[0].shape, np.float32)
         label = np.empty((W, tau, B), np.float32)
+        worker_s = []
         for w in range(W):
+            t0 = time.perf_counter()
+            if straggle == w:
+                time.sleep(self.plan.straggler_s)
             for t in range(tau):
                 i = (r * W * tau + w * tau + t) % n
                 data[w, t] = self.xs[i]
                 label[w, t] = self.ys[i]
+            worker_s.append(time.perf_counter() - t0)
+        # per-worker assemble attribution (no-op without a profiler)
+        _profile.note_worker_phase(r, "assemble", worker_s)
         if r in self._nans:
             # poison the planned workers' batches with NaN — the
             # diverging-worker fault the numerics audit must catch
@@ -465,87 +515,129 @@ def run_chaos(
                         f"worker(s) {verdict.masked_workers}; average "
                         "stayed healthy"
                     )
+        if (
+            profiler is not None
+            and r == plan.straggler_round
+            and counters.get("straggler_injected")
+            # a post-resume REPLAY of this round has no injected sleep
+            # (the fault already discharged) — the first visit's verdict
+            # must not be overwritten by the healthy replay's
+            and "straggler_detected_worker" not in counters
+        ):
+            # survived = the round profiler's verdict names EXACTLY the
+            # seeded worker (per-worker attribution, not just "slow")
+            rec = profiler.last()
+            w = (rec or {}).get("worker")
+            counters["straggler_detected_worker"] = (
+                w["worst_worker"] if w else None
+            )
+            if (
+                rec is not None
+                and rec["round"] == r
+                and w is not None
+                and w["straggler"]
+                and w["worst_worker"] == plan.straggler_worker
+            ):
+                counters["straggler_survived"] = 1
+                note(
+                    "round %d: profiler attributed the slow round to "
+                    "worker %d (skew %.2f) — straggler verdict exact"
+                    % (r, w["worst_worker"], w["skew"])
+                )
 
+    # the round profiler attributes the seeded straggler (installed for
+    # the faulted run only; the baseline above ran unprofiled)
+    profiler = None
+    if plan.straggler_round is not None:
+        profiler = _profile.install(_profile.RoundProfiler())
     t_preempt = None
-    with SignalHandler(
-        sigint_effect=SolverAction.NONE,
-        sighup_effect=SolverAction.SNAPSHOT,
-    ) as handler:
-        for r in range(plan.rounds):
-            run_round(feed, r)
-            snapped = (r + 1) % plan.snapshot_every == 0
-            if snapped:
-                take_snapshot(r)
-            if plan.preempt_round is not None and r == plan.preempt_round:
-                # a REAL signal, not a flag: the orchestrator's
-                # preemption notice arrives as SIGHUP
-                os.kill(os.getpid(), _signal.SIGHUP)
-                # the driver's poll sees SNAPSHOT (reference SIGHUP
-                # semantics), saves — unless the periodic snapshot
-                # already covered this exact iteration — and "dies"
-                if (
-                    handler.get_action() == SolverAction.SNAPSHOT
-                    and not snapped
-                ):
+    try:
+        with SignalHandler(
+            sigint_effect=SolverAction.NONE,
+            sighup_effect=SolverAction.SNAPSHOT,
+        ) as handler:
+            for r in range(plan.rounds):
+                run_round(feed, r)
+                snapped = (r + 1) % plan.snapshot_every == 0
+                if snapped:
                     take_snapshot(r)
-                counters["preempt_injected"] = 1
-                t_preempt = time.perf_counter()
-                preempted_at = r
-                _obs.fault("preemption", round=r)
-                note(f"round {r}: SIGHUP preemption — simulated process death")
-                break
-    feed.close()
-
-    resumed_from_iter = None
-    quarantined: List[str] = []
-    recovery_latency_s = None
-    if preempted_at is not None:
-        # simulated restart: live state is GONE; only files survive
-        state = None
-        if plan.corrupt_newest:
-            newest = checkpoint.find_snapshots(prefix)[-1]
-            corrupt_file(newest, seed=plan.seed)
-            counters["corruption_injected"] = 1
-            _obs.fault(
-                "snapshot_corruption", snapshot=os.path.basename(newest)
-            )
-            note(f"corrupted newest snapshot {os.path.basename(newest)}")
-        st, used = checkpoint.restore_newest_valid(solver, prefix)
-        resumed_from_iter = int(np.asarray(st.iter))
-        quarantined = [
-            os.path.basename(p)
-            for p in sorted(os.listdir(workdir))
-            if p.endswith(".corrupt")
-        ]
-        if plan.corrupt_newest:
-            if quarantined and used != newest:
-                counters["corruption_survived"] = 1
-            note(
-                f"resume fell back to {os.path.basename(used)} "
-                f"(quarantined: {quarantined})"
-            )
-        state = broadcast(st)
-        recovery_latency_s = time.perf_counter() - t_preempt
-        counters["preempt_survived"] = 1
-        _obs.instant(
-            "recovered", kind="preemption",
-            latency_s=round(recovery_latency_s, 3),
-            resumed_iter=resumed_from_iter,
-        )
-        start_round = resumed_from_iter // plan.tau
-        note(
-            "resumed at round %d (iter %d) in %.2fs; replaying %d round(s)"
-            % (
-                start_round,
-                resumed_from_iter,
-                recovery_latency_s,
-                preempted_at + 1 - start_round,
-            )
-        )
-        feed = _Feed(plan, xs, ys, counters, events, mesh, fault_state)
-        for r in range(start_round, plan.rounds):
-            run_round(feed, r)
+                if plan.preempt_round is not None and r == plan.preempt_round:
+                    # a REAL signal, not a flag: the orchestrator's
+                    # preemption notice arrives as SIGHUP
+                    os.kill(os.getpid(), _signal.SIGHUP)
+                    # the driver's poll sees SNAPSHOT (reference SIGHUP
+                    # semantics), saves — unless the periodic snapshot
+                    # already covered this exact iteration — and "dies"
+                    if (
+                        handler.get_action() == SolverAction.SNAPSHOT
+                        and not snapped
+                    ):
+                        take_snapshot(r)
+                    counters["preempt_injected"] = 1
+                    t_preempt = time.perf_counter()
+                    preempted_at = r
+                    _obs.fault("preemption", round=r)
+                    note(
+                        f"round {r}: SIGHUP preemption — simulated "
+                        "process death"
+                    )
+                    break
         feed.close()
+
+        resumed_from_iter = None
+        quarantined: List[str] = []
+        recovery_latency_s = None
+        if preempted_at is not None:
+            # simulated restart: live state is GONE; only files survive
+            state = None
+            if plan.corrupt_newest:
+                newest = checkpoint.find_snapshots(prefix)[-1]
+                corrupt_file(newest, seed=plan.seed)
+                counters["corruption_injected"] = 1
+                _obs.fault(
+                    "snapshot_corruption", snapshot=os.path.basename(newest)
+                )
+                note(f"corrupted newest snapshot {os.path.basename(newest)}")
+            st, used = checkpoint.restore_newest_valid(solver, prefix)
+            resumed_from_iter = int(np.asarray(st.iter))
+            quarantined = [
+                os.path.basename(p)
+                for p in sorted(os.listdir(workdir))
+                if p.endswith(".corrupt")
+            ]
+            if plan.corrupt_newest:
+                if quarantined and used != newest:
+                    counters["corruption_survived"] = 1
+                note(
+                    f"resume fell back to {os.path.basename(used)} "
+                    f"(quarantined: {quarantined})"
+                )
+            state = broadcast(st)
+            recovery_latency_s = time.perf_counter() - t_preempt
+            counters["preempt_survived"] = 1
+            _obs.instant(
+                "recovered", kind="preemption",
+                latency_s=round(recovery_latency_s, 3),
+                resumed_iter=resumed_from_iter,
+            )
+            start_round = resumed_from_iter // plan.tau
+            note(
+                "resumed at round %d (iter %d) in %.2fs; replaying %d "
+                "round(s)"
+                % (
+                    start_round,
+                    resumed_from_iter,
+                    recovery_latency_s,
+                    preempted_at + 1 - start_round,
+                )
+            )
+            feed = _Feed(plan, xs, ys, counters, events, mesh, fault_state)
+            for r in range(start_round, plan.rounds):
+                run_round(feed, r)
+            feed.close()
+    finally:
+        if profiler is not None:
+            _profile.uninstall(profiler)
 
     final_loss = final_round_loss(losses)
     if counters.get("dead_worker_injected") and np.isfinite(final_loss):
@@ -568,6 +660,9 @@ def run_chaos(
         ),
         "dead_worker": ("dead_worker_injected", "dead_worker_survived"),
         "nan_injection": ("nan_injected", "nan_survived"),
+        "straggler_injection": (
+            "straggler_injected", "straggler_survived",
+        ),
     }
     faults = {
         kind: {
@@ -590,6 +685,11 @@ def run_chaos(
         "watchdog_fires": int(counters.get("watchdog_fires", 0)),
         "nan_round": plan.nan_round,
         "nan_detected_round": counters.get("nan_detected_round"),
+        "straggler_round": plan.straggler_round,
+        "straggler_worker": plan.straggler_worker,
+        "straggler_detected_worker": counters.get(
+            "straggler_detected_worker"
+        ),
         "recovery_latency_s": (
             round(recovery_latency_s, 3)
             if recovery_latency_s is not None

@@ -34,6 +34,7 @@ import numpy as np
 
 from sparknet_tpu import obs
 from sparknet_tpu.obs import health as _health
+from sparknet_tpu.obs import profile as _profile
 from sparknet_tpu.config import load_net_prototxt
 from sparknet_tpu.config.schema import NetParameter, SolverParameter, solver_method
 from sparknet_tpu.net import JaxNet, Params, Stats
@@ -403,6 +404,7 @@ class Solver:
         if tm is not None:
             tm.rounds.inc()
             tm.iters.inc(losses.shape[0])  # tau (shape read: no sync)
+        _profile.observe_round_if_active(losses)  # --profile round mark
         obs.report_healthy()
         if self.audit:
             return state, losses, stats

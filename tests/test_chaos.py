@@ -27,6 +27,16 @@ def test_default_plan_covers_every_fault_class():
     # the resume replay, and on a different worker than the dead one
     assert plan.nan_round < plan.preempt_round
     assert plan.dead_worker not in plan.nan_workers
+    # the straggler fault: seeded before the preemption (fires once,
+    # not re-fired by the replay), on a worker distinct from the nan
+    # and dead ones so each fault's attribution is unambiguous, and
+    # sleeping well under the stall watchdog (stalls are a different
+    # fault class)
+    assert plan.straggler_round is not None
+    assert plan.straggler_round < plan.preempt_round
+    assert plan.straggler_worker != plan.dead_worker
+    assert plan.straggler_worker not in plan.nan_workers
+    assert plan.straggler_s < plan.stall_timeout_s
     # the preemption must happen after at least one periodic snapshot,
     # or there is nothing valid to fall back to after the corruption
     assert plan.preempt_round + 1 > plan.snapshot_every
@@ -37,6 +47,7 @@ def test_no_fault_view_strips_all_faults():
     assert base.storage_faults == () and base.stall_rounds == ()
     assert base.preempt_round is None and not base.corrupt_newest
     assert base.dead_worker is None and base.nan_round is None
+    assert base.straggler_round is None
     # run geometry unchanged: the baseline is comparable
     plan = chaos.FaultPlan.default()
     for f in ("seed", "workers", "rounds", "tau", "batch"):
@@ -109,7 +120,7 @@ def test_feed_delivers_rounds_in_order_across_watchdog_rebuild():
         storage_faults=(), stall_rounds=(1,),
         stall_s=0.8, stall_timeout_s=0.2,
         preempt_round=None, corrupt_newest=False, dead_worker=None,
-        nan_round=None,
+        nan_round=None, straggler_round=None,
     )
     # distinct constant per minibatch index -> contents identify indices
     xs = [np.full((4, 3, 4, 4), i, np.float32) for i in range(8)]
@@ -163,6 +174,11 @@ def test_chaos_smoke_default_plan(tmp_path):
     assert rep["loss_band_ok"], (
         rep["final_loss"], rep["baseline_final_loss"], rep["loss_band"]
     )
+
+    # the seeded straggler was attributed to EXACTLY the seeded worker
+    # (the profiler's per-worker verdict, ISSUE 7 acceptance)
+    assert rep["faults"]["straggler_injection"]["survived"] == 1
+    assert rep["straggler_detected_worker"] == rep["straggler_worker"]
 
     # quarantined files really are on disk, out of the resume scan
     corrupt = [f for f in os.listdir(str(tmp_path)) if f.endswith(".corrupt")]

@@ -301,6 +301,34 @@ def test_cli_train_obs_flags_write_trace_and_serve_metrics(
     )
 
 
+def test_cli_train_profile_flag_prints_round_anatomy(
+    tmp_path, toy_model, capsys
+):
+    """`train --profile`: the round-anatomy profiler rides the run (no
+    tracer needed), prints its summary table at close, and is
+    uninstalled afterward — a later run in the same process must not
+    inherit the span observer (ISSUE 7 wiring)."""
+    from sparknet_tpu import obs
+
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(
+        f'net: "{toy_model}"\nbase_lr: 0.01\nlr_policy: "fixed"\n'
+        "max_iter: 4\n"
+        f'snapshot_prefix: "{tmp_path}/prof"\n'
+    )
+    rc = cli.main(["train", f"--solver={solver}", "--tau=2", "--profile"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "obs: round-anatomy profiler on" in out
+    # 2 windows of tau=2: the end-of-run anatomy table rode stdout
+    assert "profile: round anatomy over 2 round(s)" in out
+    assert "execute" in out
+    # run teardown cleared the global profiler
+    assert obs.profile.active() is None
+    assert obs.profile_state() is None
+    obs._reset_training_metrics_for_tests()
+
+
 def test_cli_train_resume_conflicts_with_snapshot(tmp_path, toy_model, capsys):
     """--resume scans the solver's snapshot_prefix; naming an explicit
     --snapshot (or --weights) alongside it is a conflict, not a silent

@@ -339,6 +339,53 @@ def test_compress_rejects_unknown_mode():
         ParameterAveragingTrainer(_solver(), mesh, compress="int4")
 
 
+def test_quant_error_telemetry_gauges():
+    """Per-round quantization-error telemetry: int8/bf16 legs export a
+    nonzero delta max-abs-err and a finite SNR gauge labeled by mode;
+    the fp32-payload plane reads exactly-zero error at the 300 dB cap.
+    The readout is dispatched in round r and landed at round r+1 (or at
+    finalize) so it never syncs the dispatch path."""
+    mesh = _mesh(2)
+    data = _data(2, 2, seed=3)
+    tm = obs.enable_training_metrics()
+    for mode, lossy in (("int8", True), ("bf16", True), ("fp32", False)):
+        t, _, _ = _run_rounds(mesh, data, rounds=3, compress=mode)
+        # finalize flushed the last pending readout into the gauges
+        err = tm.quant_error.labels(t._comm.compress).value
+        snr = tm.quant_snr_db.labels(t._comm.compress).value
+        if lossy:
+            assert err > 0, mode
+            assert 0 < snr < 300, mode
+        else:
+            assert err == 0.0
+            assert snr == 300.0  # error underflowed to exactly 0
+    # int8's coarser grid must show a worse SNR than bf16's
+    assert (
+        tm.quant_snr_db.labels("int8").value
+        < tm.quant_snr_db.labels("bf16").value
+    )
+
+
+def test_quant_error_readout_returns_values():
+    """flush_quant_error returns the readout dict (None when nothing is
+    pending) — the surface bench/scaling legs read directly."""
+    mesh = _mesh(2)
+    data = _data(2, 2, seed=5)
+    obs.enable_training_metrics()
+    solver = _solver(momentum=0.9)
+    trainer = ParameterAveragingTrainer(solver, mesh, compress="int8")
+    st = trainer.init_state(seed=0)
+    st, _ = trainer.round(st, shard_leading(data, mesh))
+    # the round DISPATCHED the readout but deliberately did not sync it
+    rec = trainer._comm.flush_quant_error()
+    assert rec is not None
+    assert rec["compress"] == "int8"
+    assert rec["max_abs_err"] > 0
+    assert np.isfinite(rec["snr_db"])
+    # nothing pending anymore
+    assert trainer._comm.flush_quant_error() is None
+
+
 def test_cli_args_roundtrip():
     import argparse
 

@@ -290,3 +290,77 @@ def test_training_log(tmp_path):
     assert "hello phase" in content
     # "elapsed: message" format like CifarApp.scala:44
     assert content.split(":")[0].replace(".", "").isdigit()
+
+
+def test_cpu_timer_lifecycle_and_units():
+    """CPUTimer (utils/timers.py): start/stop semantics, the has-run
+    flag, unit conversions, and idempotent stop."""
+    import time
+
+    from sparknet_tpu.utils.timers import CPUTimer
+
+    t = CPUTimer()
+    assert t.has_run_at_least_once is False
+    assert t.milli_seconds() == 0.0
+    assert t.stop() is t  # stop before start: a no-op, not a crash
+    assert t.has_run_at_least_once is False
+    t.start()
+    time.sleep(0.01)
+    t.stop()
+    assert t.has_run_at_least_once is True
+    assert t.seconds() >= 0.01
+    assert t.milli_seconds() == pytest.approx(t.seconds() * 1e3)
+    assert t.micro_seconds() == pytest.approx(t.seconds() * 1e6)
+    # a second stop without a start keeps the previous reading
+    prev = t.seconds()
+    t.stop()
+    assert t.seconds() == prev
+    # restart overwrites, not accumulates (the reference's semantics)
+    t.start()
+    t.stop()
+    assert t.seconds() < prev
+
+
+def test_device_timer_syncs_on_given_arrays(monkeypatch):
+    """Timer (the device-sync path): stop() must block on the sync_on
+    arrays BEFORE reading the clock — the cudaEvent-timer analog.  The
+    wiring is asserted deterministically (block_until_ready called with
+    exactly the sync target, before the clock read), plus a live run
+    against a real dispatched computation."""
+    import time
+
+    import jax.numpy as jnp
+
+    from sparknet_tpu.utils import timers
+
+    calls = []
+    real_block = jax.block_until_ready
+
+    def spy(x):
+        calls.append(x)
+        return real_block(x)
+
+    monkeypatch.setattr(jax, "block_until_ready", spy)
+    target = jnp.arange(4.0)
+    t = timers.Timer(sync_on=target)
+    t.start()
+    time.sleep(0.002)
+    t.stop()
+    assert calls == [target]  # synced on exactly the given arrays
+    assert t.has_run_at_least_once and t.seconds() > 0
+    monkeypatch.undo()
+
+    # live: the timed window covers a real dispatched computation
+    x = jnp.ones((256, 256))
+    y = x @ x @ x
+    t2 = timers.Timer(sync_on=y)
+    t2.start()
+    t2.stop()
+    assert t2.has_run_at_least_once
+    assert float(y[0, 0]) > 0  # the synced value is usable immediately
+
+    # sync_on=None degrades to the pure wall-clock CPUTimer
+    t3 = timers.Timer()
+    t3.start()
+    t3.stop()
+    assert t3.has_run_at_least_once

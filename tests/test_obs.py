@@ -469,26 +469,87 @@ def _repo_tools_trace_report():
     return mod
 
 
-def test_trace_report_folds_phases_and_detects_overlap(tmp_path):
+def test_trace_report_folds_phases_and_measures_hidden_fraction(tmp_path):
     tr = _repo_tools_trace_report()
-    # hand-built events: producer assemble overlaps consumer execute
+    # hand-built events: producer assemble HALF overlaps consumer
+    # execute (150 of 300 us inside the first execute span)
     events = [
         {"name": "execute", "ph": "X", "ts": 0.0, "dur": 1000.0, "tid": 1},
-        {"name": "assemble", "ph": "X", "ts": 200.0, "dur": 300.0, "tid": 2},
+        {"name": "assemble", "ph": "X", "ts": 850.0, "dur": 300.0,
+         "tid": 2, "args": {"round": 1}},
         {"name": "execute", "ph": "X", "ts": 1200.0, "dur": 800.0, "tid": 1},
         {"name": "fault_storage", "ph": "i", "ts": 50.0, "tid": 2},
     ]
     rep = tr.fold(events)
+    # the boolean audit is now DERIVED from the measured fraction
     assert rep["producer_overlap_observed"] is True
+    assert rep["producer_hidden_fraction"] == pytest.approx(0.5)
+    per = rep["producer_hidden_fraction_per_round"]
+    assert per["rounds"] == 1 and per["p50"] == pytest.approx(0.5)
     assert rep["phases"]["execute"]["count"] == 2
     assert rep["phases"]["execute"]["total_ms"] == 1.8
     assert rep["phases"]["assemble"]["mean_ms"] == 0.3
     assert rep["instants"] == {"fault_storage": 1}
+    assert rep["comm"] is None  # trace predates the comm plane
     table = tr.format_report(rep)
-    assert "execute" in table and "YES" in table
-    # serial trace (same tid): no overlap claimed
+    assert "execute" in table and "hidden under execute: 50.0%" in table
+    # serial trace (same tid): 0 hidden, no overlap claimed
     serial = [dict(e, tid=1) for e in events if e["ph"] == "X"]
-    assert tr.fold(serial)["producer_overlap_observed"] is False
+    rep2 = tr.fold(serial)
+    assert rep2["producer_overlap_observed"] is False
+    assert rep2["producer_hidden_fraction"] == 0.0
+
+
+def test_trace_report_hidden_fraction_not_inflated_by_nested_spans():
+    """The PA trainer's traces NEST execute inside average on the same
+    consumer thread — coverage must be the interval UNION, not the
+    pairwise sum (which double-counts and can report a half-hidden
+    producer as fully hidden, masking a partially collapsed pipeline)."""
+    tr = _repo_tools_trace_report()
+    events = [
+        # consumer: average 0-50us wrapping execute 1-49us (nested)
+        {"name": "average", "ph": "X", "ts": 0.0, "dur": 50.0, "tid": 1},
+        {"name": "execute", "ph": "X", "ts": 1.0, "dur": 48.0, "tid": 1},
+        # producer: 0-100us — exactly half runs under the consumer
+        {"name": "assemble", "ph": "X", "ts": 0.0, "dur": 100.0,
+         "tid": 2, "args": {"round": 0}},
+    ]
+    rep = tr.fold(events)
+    assert rep["producer_hidden_fraction"] == pytest.approx(0.5)
+
+
+def test_trace_report_folds_comm_spans():
+    """The PR-6 comm spans (quantize/allreduce/dequantize with their
+    chunk=/stage=/compress= args) fold into the compressed-collective
+    section — alongside, not instead of, the producer phases."""
+    tr = _repo_tools_trace_report()
+    events = [
+        {"name": "execute", "ph": "X", "ts": 0.0, "dur": 500.0, "tid": 1},
+        {"name": "quantize", "ph": "X", "ts": 500.0, "dur": 40.0,
+         "tid": 1, "args": {"compress": "int8"}},
+        {"name": "allreduce", "ph": "X", "ts": 540.0, "dur": 100.0,
+         "tid": 9, "args": {"chunk": 0, "nbytes": 4096}},
+        {"name": "allreduce", "ph": "X", "ts": 640.0, "dur": 120.0,
+         "tid": 9, "args": {"chunk": 3, "nbytes": 8192}},
+        {"name": "dequantize", "ph": "X", "ts": 760.0, "dur": 30.0,
+         "tid": 1, "args": {"stage": "correction"}},
+        {"name": "assemble", "ph": "X", "ts": 100.0, "dur": 200.0,
+         "tid": 2, "args": {"round": 1}},
+    ]
+    rep = tr.fold(events)
+    comm = rep["comm"]
+    assert comm["allreduce"]["count"] == 2
+    assert comm["allreduce"]["chunks"] == [0, 3]
+    assert comm["allreduce"]["nbytes_total"] == 4096 + 8192
+    assert comm["allreduce"]["total_ms"] == pytest.approx(0.22)
+    assert comm["quantize"]["compress"] == ["int8"]
+    assert comm["dequantize"]["stages"] == {"correction": 1}
+    # producer phases still fold beside the comm section
+    assert rep["phases"]["assemble"]["count"] == 1
+    assert rep["producer_hidden_fraction"] == pytest.approx(1.0)
+    table = tr.format_report(rep)
+    assert "compressed collective: allreduce x2" in table
+    assert "quantize x1" in table and "dequantize x1" in table
 
 
 def test_trace_report_reads_tracer_output_both_formats(tmp_path):

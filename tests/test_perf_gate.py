@@ -1,0 +1,212 @@
+"""Perf-regression gate (``tools/perf_gate.py``): the rules engine over
+synthetic artifact sets, the newest-per-family selection, the live-
+profile comparison, and the CLI contract."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _gate():
+    spec = importlib.util.spec_from_file_location(
+        "perf_gate", os.path.join(_REPO, "tools", "perf_gate.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write(root, name, obj):
+    with open(os.path.join(str(root), name), "w") as f:
+        json.dump(obj, f)
+
+
+GOOD_PIPELINE = {
+    "value": 1.7, "overlap_efficiency": 0.95,
+    "pipelined_round_ms": 900.0, "serial_round_ms": 1600.0,
+}
+GOOD_PROFILE = {
+    "overhead_profiled_pct": 0.4, "straggler_attributed": True,
+    "hidden_frac_h2d_p50": 0.99, "flops_cross_check_ratio": 2.5,
+    "profiled_round_ms": 1000.0,
+}
+
+
+def test_newest_artifact_per_family_wins(tmp_path):
+    g = _gate()
+    _write(tmp_path, "PIPELINE_r08.json", GOOD_PIPELINE)
+    _write(tmp_path, "PIPELINE_r03.json", {"value": 0.2})  # old history
+    _write(tmp_path, "BENCH_r04_googlenet.json", {"value": 50.0})
+    _write(tmp_path, "BASELINE.json", {"value": -1})  # not an artifact
+    _write(tmp_path, "notes_r99.json", {"value": -1})  # unknown family
+    arts = g.find_artifacts(str(tmp_path))
+    assert arts["PIPELINE"][0] == 8
+    assert [os.path.basename(p) for p in arts["PIPELINE"][1]] == [
+        "PIPELINE_r08.json"
+    ]
+    assert arts["BENCH"][0] == 4  # suffixed variants count in-family
+    assert set(arts) == {"PIPELINE", "BENCH"}
+    # ALL same-newest-round variants are returned (unsuffixed first) so
+    # the gate validates every one, not an arbitrary glob-order pick
+    _write(tmp_path, "BENCH_r04.json", {"value": 60.0})
+    _write(tmp_path, "BENCH_r04_resnet50.json", {"value": 70.0})
+    arts = g.find_artifacts(str(tmp_path))
+    assert [os.path.basename(p) for p in arts["BENCH"][1]] == [
+        "BENCH_r04.json", "BENCH_r04_googlenet.json",
+        "BENCH_r04_resnet50.json",
+    ]
+    # a regression in ANY same-round variant fails --check
+    _write(tmp_path, "BENCH_r04_googlenet.json", {"value": 0})
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 1
+    assert any(
+        r["artifact"] == "BENCH_r04_googlenet.json" and not r["ok"]
+        for r in rows
+    )
+    # suffixes with underscores (BENCH_MODEL=cifar10_full) are in-family
+    # too — a newer such artifact must supersede and be validated
+    _write(tmp_path, "BENCH_r06_cifar10_full.json", {"value": 0})
+    arts = g.find_artifacts(str(tmp_path))
+    assert arts["BENCH"][0] == 6
+    rc, rows = g.check(str(tmp_path))
+    assert any(
+        r["artifact"] == "BENCH_r06_cifar10_full.json" and not r["ok"]
+        for r in rows
+    )
+
+
+def test_check_passes_good_set_and_fails_regressions(tmp_path):
+    g = _gate()
+    _write(tmp_path, "PIPELINE_r08.json", GOOD_PIPELINE)
+    _write(tmp_path, "PROFILE_r11.json", GOOD_PROFILE)
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 0, rows
+    # the cross-artifact rule ran: live hidden fraction vs offline eff
+    assert any(r["family"] == "PROFILE x PIPELINE" for r in rows)
+    # regress the pipeline below the bar -> nonzero
+    _write(
+        tmp_path, "PIPELINE_r09.json",
+        dict(GOOD_PIPELINE, value=0.9, pipelined_round_ms=1700.0),
+    )
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 1
+    fails = [r for r in rows if not r["ok"]]
+    assert any("value" in r["detail"] for r in fails)
+    assert any("pipelined_round_ms" in r["detail"] for r in fails)
+
+
+def test_check_fails_on_collapsed_live_hidden_fraction(tmp_path):
+    """The cross-artifact band: a PROFILE artifact whose live hidden
+    fraction collapsed must fail against the committed PIPELINE
+    efficiency even if its own fields look self-consistent."""
+    g = _gate()
+    _write(tmp_path, "PIPELINE_r08.json", GOOD_PIPELINE)
+    _write(
+        tmp_path, "PROFILE_r11.json",
+        dict(GOOD_PROFILE, hidden_frac_h2d_p50=0.1),
+    )
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 1
+    bad = [r for r in rows if not r["ok"]]
+    assert any(r["family"] == "PROFILE x PIPELINE" for r in bad)
+
+
+def test_missing_key_is_a_failure_not_a_pass(tmp_path):
+    g = _gate()
+    _write(tmp_path, "OBS_r09.json", {"overhead_traced_pct": 0.5})
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 1
+    assert any("MISSING" in r["detail"] for r in rows if not r["ok"])
+
+
+def test_live_summary_vs_baselines(tmp_path):
+    g = _gate()
+    _write(tmp_path, "PIPELINE_r08.json", GOOD_PIPELINE)
+    _write(tmp_path, "PROFILE_r11.json", GOOD_PROFILE)
+    # a RoundProfiler.summary() dump, healthy
+    live = {
+        "rounds": 10,
+        "hidden_frac_h2d": {"p50": 0.98, "min": 0.0, "max": 1.0},
+        "round_ms": {"p50": 1100.0, "max": 1400.0},
+        "straggler_rounds": 1,
+    }
+    _write(tmp_path, "live.json", live)
+    rc, rows = g.check_live(
+        os.path.join(str(tmp_path), "live.json"), str(tmp_path)
+    )
+    assert rc == 0, rows
+    # collapsed overlap -> fail
+    _write(
+        tmp_path, "live_bad.json",
+        dict(live, hidden_frac_h2d={"p50": 0.1, "min": 0, "max": 0.2}),
+    )
+    rc, rows = g.check_live(
+        os.path.join(str(tmp_path), "live_bad.json"), str(tmp_path)
+    )
+    assert rc == 1
+    # round time blown past tolerance -> fail
+    _write(
+        tmp_path, "live_slow.json",
+        dict(live, round_ms={"p50": 1000.0 * 1.6, "max": 2000.0}),
+    )
+    rc, _ = g.check_live(
+        os.path.join(str(tmp_path), "live_slow.json"), str(tmp_path),
+        tolerance=0.5,
+    )
+    assert rc == 1
+    # a standing straggler (every round flagged) -> fail
+    _write(
+        tmp_path, "live_strag.json", dict(live, straggler_rounds=10),
+    )
+    rc, rows = g.check_live(
+        os.path.join(str(tmp_path), "live_strag.json"), str(tmp_path)
+    )
+    assert rc == 1
+    assert any("standing straggler" in r["detail"] for r in rows)
+    # a serial-feed / bare-solver run (no producer spans at all) carries
+    # hidden_frac_h2d: null — nothing to compare, NOT a regression (a
+    # collapsed pipeline reads ~0.0, not null, and fails the band above)
+    _write(
+        tmp_path, "live_serial.json", dict(live, hidden_frac_h2d=None),
+    )
+    rc, rows = g.check_live(
+        os.path.join(str(tmp_path), "live_serial.json"), str(tmp_path)
+    )
+    assert rc == 0, rows
+    assert any("skipped" in r["detail"] for r in rows)
+    # a PROFILE_* bench artifact's straggler counter comes from its
+    # deliberately SEEDED leg — never a "standing straggler" verdict
+    _write(
+        tmp_path, "live_seeded.json",
+        dict(
+            live, hidden_frac_h2d_p50=0.98, rounds=2,
+            straggler_rounds=2, straggler_seeded_worker=1,
+        ),
+    )
+    rc, rows = g.check_live(
+        os.path.join(str(tmp_path), "live_seeded.json"), str(tmp_path)
+    )
+    assert rc == 0, rows
+
+
+def test_cli_contract(tmp_path, capsys):
+    g = _gate()
+    _write(tmp_path, "PIPELINE_r08.json", GOOD_PIPELINE)
+    rc = g.main(["--check", "--root", str(tmp_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "perf gate:" in out and "0 failure(s)" in out
+    _write(tmp_path, "PIPELINE_r09.json", {"value": 0.5})
+    assert g.main(["--check", "--root", str(tmp_path)]) == 1
+    capsys.readouterr()
+    # --json emits machine rows
+    rc = g.main(["--check", "--root", str(tmp_path), "--json"])
+    assert rc == 1
+    rows = json.loads(capsys.readouterr().out)
+    assert isinstance(rows, list) and any(not r["ok"] for r in rows)
+    with pytest.raises(SystemExit):
+        g.main([])  # neither --check nor --live is an error

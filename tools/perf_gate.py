@@ -1,0 +1,403 @@
+"""Perf-regression gate over the committed benchmark artifacts.
+
+The repo's perf story is a trajectory of committed one-line JSON
+artifacts (BENCH_*, PIPELINE_*, OBS_*, HEALTH_*, COMM_*, PROFILE_*,
+...).  Each carries pinned bands in its schema tests, but nothing
+checked them *as a set*, and nothing compared a live run against them.
+This gate does both:
+
+``--check``
+    Validate the NEWEST artifact of every family in the repo root
+    against its pinned-band rules (the same done-bars the bench modes
+    print), plus the cross-artifact rules (e.g. the live
+    hidden-fraction in PROFILE_* must sit within band of PIPELINE_*'s
+    offline overlap efficiency).  Exit 1 on any out-of-band value —
+    the tier-1 guard that makes a PR which regresses a pinned band
+    fail fast.
+
+``--live RUN.json``
+    Fold a live profile (a ``RoundProfiler.summary()`` dump, or a
+    PROFILE_* artifact) against the committed baselines: hidden
+    fraction within band, round time within tolerance of the committed
+    profile leg.  Exit 1 when the live run regressed out of band.
+
+    python tools/perf_gate.py --check
+    python tools/perf_gate.py --check --json
+    python tools/perf_gate.py --live my_profile.json --tolerance 0.5
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# live hidden-fraction band vs PIPELINE's offline overlap efficiency:
+# the two measure the same overlap through different protocols (A/B
+# wall-clock vs span-interval accounting), so the band is generous but
+# a collapsed pipeline (fraction ~0) must fail.
+HIDDEN_FRACTION_BAND = 0.25
+
+
+def _get(d: dict, path: str):
+    cur = d
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+class Rule:
+    """One pinned band: ``key op bound`` over an artifact dict."""
+
+    def __init__(self, key: str, op: str, bound):
+        self.key, self.op, self.bound = key, op, bound
+
+    def check(self, art: dict) -> Tuple[bool, str]:
+        v = _get(art, self.key)
+        ok = False
+        if v is None:
+            return False, f"{self.key}: MISSING (want {self.op} {self.bound})"
+        if self.op == ">":
+            ok = v > self.bound
+        elif self.op == ">=":
+            ok = v >= self.bound
+        elif self.op == "<":
+            ok = v < self.bound
+        elif self.op == "<=":
+            ok = v <= self.bound
+        elif self.op == "==":
+            ok = v == self.bound
+        elif self.op == "is":
+            ok = v is self.bound
+        return ok, f"{self.key}={v!r} {self.op} {self.bound!r}"
+
+
+# pinned bands per artifact family — the same done-bars the bench modes
+# and test_bench_smoke schema tests enforce, applied to the NEWEST
+# artifact of each family.  Older artifacts are history, not contracts.
+RULES: Dict[str, List[Rule]] = {
+    "BENCH": [Rule("value", ">", 0)],
+    "HOSTFEED": [
+        Rule("value", ">=", 267.0),  # the reference K40 row, measured
+        Rule("vs_baseline", ">=", 1.0),
+    ],
+    # MULTICHIP artifacts are pass/fail dryrun records, not rates
+    "MULTICHIP": [Rule("ok", "is", True), Rule("rc", "==", 0)],
+    "SCALING": [Rule("value", ">", 0)],
+    "SERVE": [
+        Rule("value", ">", 0),
+        Rule("recompiles_after_warmup", "==", 0),
+        Rule("batch_occupancy_mean", ">", 0),
+        Rule("batch_occupancy_mean", "<=", 1.0),
+    ],
+    "CHAOS": [
+        Rule("loss_band_ok", "is", True),
+        Rule("faults_injected", ">", 0),
+    ],
+    "PIPELINE": [
+        Rule("value", ">", 1.0),  # pipelined strictly faster than serial
+        Rule("overlap_efficiency", ">=", 0.5),
+    ],
+    "OBS": [
+        Rule("overhead_traced_pct", "<", 2.0),
+        Rule("off_span_ns", "<", 100_000),
+        Rule("producer_overlap_observed", "is", True),
+    ],
+    "HEALTH": [
+        Rule("overhead_audit_pct", "<", 2.0),
+        Rule("bit_identical", "is", True),
+        Rule("detection_exact", "is", True),
+        Rule("loss_band_ok", "is", True),
+        Rule("rollbacks", ">=", 1),
+    ],
+    "COMM": [
+        Rule("overlap_vs_ideal", "<=", 1.15),
+        Rule("bytes_ratio_int8", ">=", 4.0 - 0.005),
+        Rule("bytes_ratio_bf16", ">=", 2.0 - 0.005),
+        Rule("loss_band_ok", "is", True),
+    ],
+    "PROFILE": [
+        Rule("overhead_profiled_pct", "<", 2.0),
+        Rule("straggler_attributed", "is", True),
+        Rule("hidden_frac_h2d_p50", ">", 0.0),
+        Rule("flops_cross_check_ratio", ">", 0.0),
+    ],
+}
+
+
+def find_artifacts(root: str = _REPO) -> Dict[str, Tuple[int, List[str]]]:
+    """Newest committed artifacts per family: ``FAMILY -> (round,
+    [paths])``.  Suffixed variants (BENCH_r04_googlenet) count in their
+    family and ALL same-newest-round variants are returned (sorted, the
+    unsuffixed one first) so the gate validates every one of them — a
+    single arbitrary glob-order pick would silently skip siblings.
+    BASELINE.json and non-artifact JSONs are ignored."""
+    newest: Dict[str, Tuple[int, List[str]]] = {}
+    for path in glob.glob(os.path.join(root, "*.json")):
+        m = re.match(
+            # suffixes may contain underscores (BENCH_r06_cifar10_full)
+            r"([A-Z]+)_r(\d+)(?:_[A-Za-z0-9_]+)?\.json$",
+            os.path.basename(path),
+        )
+        if not m or m.group(1) not in RULES:
+            continue
+        fam, rnd = m.group(1), int(m.group(2))
+        if fam not in newest or rnd > newest[fam][0]:
+            newest[fam] = (rnd, [path])
+        elif rnd == newest[fam][0]:
+            newest[fam][1].append(path)
+    for rnd, paths in newest.values():
+        paths.sort()
+    return newest
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        d = json.load(f)
+    # the unsuffixed BENCH_r* artifacts are driver wrapper records
+    # ({n, cmd, rc, tail, parsed: {...}}) with the one-line artifact
+    # nested under "parsed"; the suffixed variants are bare.  Unwrap so
+    # both shapes meet the same rules.
+    if isinstance(d, dict) and "value" not in d and isinstance(
+        d.get("parsed"), dict
+    ):
+        return d["parsed"]
+    return d
+
+
+def _chaos_survival_rule(art: dict) -> Tuple[bool, str]:
+    ok = art.get("faults_survived") == art.get("faults_injected")
+    return ok, (
+        "faults_survived=%r == faults_injected=%r"
+        % (art.get("faults_survived"), art.get("faults_injected"))
+    )
+
+
+def _pipeline_order_rule(art: dict) -> Tuple[bool, str]:
+    ok = art.get("pipelined_round_ms", 1e99) < art.get("serial_round_ms", 0)
+    return ok, (
+        "pipelined_round_ms=%r < serial_round_ms=%r"
+        % (art.get("pipelined_round_ms"), art.get("serial_round_ms"))
+    )
+
+
+_EXTRA_RULES = {
+    "CHAOS": [_chaos_survival_rule],
+    "PIPELINE": [_pipeline_order_rule],
+}
+
+
+def _cross_rules(arts: Dict[str, dict]) -> List[Tuple[str, bool, str]]:
+    """Cross-artifact bands: a claim proved offline must still hold in
+    the live-profile artifact."""
+    out = []
+    prof = arts.get("PROFILE")
+    pipe = arts.get("PIPELINE")
+    if prof is not None and pipe is not None:
+        eff = pipe.get("overlap_efficiency")
+        live = prof.get("hidden_frac_h2d_p50")
+        if eff is not None and live is not None:
+            floor = eff - HIDDEN_FRACTION_BAND
+            out.append((
+                "PROFILE x PIPELINE",
+                live >= floor,
+                "live hidden_frac_h2d_p50=%r >= overlap_efficiency-%.2f"
+                "=%.3f" % (live, HIDDEN_FRACTION_BAND, floor),
+            ))
+    return out
+
+
+def check(root: str = _REPO) -> Tuple[int, List[dict]]:
+    """Run every family's rules over its newest artifact.  Returns
+    (exit code, result rows)."""
+    rows: List[dict] = []
+    arts: Dict[str, dict] = {}
+    rc = 0
+    for fam, (rnd, paths) in sorted(find_artifacts(root).items()):
+        for path in paths:
+            try:
+                art = _load(path)
+            except (OSError, ValueError) as e:
+                rows.append({
+                    "family": fam, "artifact": os.path.basename(path),
+                    "ok": False, "detail": f"unreadable: {e}",
+                })
+                rc = 1
+                continue
+            # cross-rules read the family's primary (unsuffixed-first)
+            # artifact — the sorted order puts it at paths[0]
+            arts.setdefault(fam, art)
+            for rule in RULES[fam]:
+                ok, detail = rule.check(art)
+                rows.append({
+                    "family": fam, "artifact": os.path.basename(path),
+                    "ok": ok, "detail": detail,
+                })
+                rc = rc or (0 if ok else 1)
+            for fn in _EXTRA_RULES.get(fam, ()):
+                ok, detail = fn(art)
+                rows.append({
+                    "family": fam, "artifact": os.path.basename(path),
+                    "ok": ok, "detail": detail,
+                })
+                rc = rc or (0 if ok else 1)
+    for name, ok, detail in _cross_rules(arts):
+        rows.append({
+            "family": name, "artifact": "(cross)", "ok": ok,
+            "detail": detail,
+        })
+        rc = rc or (0 if ok else 1)
+    return rc, rows
+
+
+def check_live(
+    live_path: str, root: str = _REPO, tolerance: float = 0.5
+) -> Tuple[int, List[dict]]:
+    """Fold a live profile against the committed baselines.  Accepts a
+    ``RoundProfiler.summary()`` JSON dump or a PROFILE_* artifact;
+    ``tolerance`` bounds the allowed round-time growth vs the committed
+    profile leg (0.5 = +50%, generous because boxes differ — the gate
+    catches collapses, CI pins exact bands)."""
+    live = _load(live_path)
+    arts = {
+        fam: _load(paths[0])  # the primary (unsuffixed-first) artifact
+        for fam, (_, paths) in find_artifacts(root).items()
+    }
+    rows: List[dict] = []
+    rc = 0
+
+    def row(ok: bool, detail: str, vs: str) -> None:
+        nonlocal rc
+        rows.append({
+            "family": "LIVE", "artifact": vs, "ok": ok, "detail": detail,
+        })
+        rc = rc or (0 if ok else 1)
+
+    # live summary vs artifact field naming
+    live_hidden = (
+        _get(live, "hidden_frac_h2d.p50")
+        if isinstance(live.get("hidden_frac_h2d"), dict)
+        else live.get("hidden_frac_h2d_p50")
+    )
+    pipe = arts.get("PIPELINE")
+    if pipe is not None and live_hidden is not None:
+        floor = pipe.get("overlap_efficiency", 0) - HIDDEN_FRACTION_BAND
+        row(
+            live_hidden >= floor,
+            "hidden_frac_h2d p50=%r >= %.3f (PIPELINE overlap_efficiency"
+            " - %.2f)" % (live_hidden, floor, HIDDEN_FRACTION_BAND),
+            "PIPELINE",
+        )
+    elif live_hidden is None:
+        # a serial-feed / bare-solver run has no producer spans at all
+        # (hidden_frac_h2d: null) — nothing to compare, not a
+        # regression.  A COLLAPSED pipeline still reads ~0.0, not null,
+        # and fails the band check above.
+        row(True, "live profile carries no hidden_frac_h2d "
+            "(serial feed or no RoundFeed) — overlap check skipped",
+            "PIPELINE")
+    live_round = (
+        _get(live, "round_ms.p50")
+        if isinstance(live.get("round_ms"), dict)
+        else live.get("profiled_round_ms")
+    )
+    prof = arts.get("PROFILE")
+    if prof is not None and live_round is not None:
+        base = prof.get("profiled_round_ms")
+        if base:
+            ceil = base * (1.0 + tolerance)
+            row(
+                live_round <= ceil,
+                "round_ms p50=%r <= %.1f (committed profile leg %.1f "
+                "+%d%%)" % (live_round, ceil, base, int(tolerance * 100)),
+                "PROFILE",
+            )
+    # prefer the window-scoped count: `rounds` is capped at the record
+    # window while `straggler_rounds` counts for the run's lifetime —
+    # comparing the two would flag long-healed runs as standing.  A
+    # PROFILE_* bench artifact carries a DELIBERATELY seeded straggler
+    # leg (straggler_seeded_worker) whose counter says nothing about a
+    # standing slow worker — skip the check for those inputs.
+    sr = live.get("straggler_rounds_window", live.get("straggler_rounds"))
+    if "straggler_seeded_worker" in live:
+        sr = None
+    if sr is not None:
+        # informational unless the live run says a straggler verdict
+        # fired every round — that is a standing slow worker
+        rounds = live.get("rounds") or live.get("rounds_profiled") or 0
+        standing = bool(rounds and sr >= rounds and rounds > 1)
+        row(
+            not standing,
+            "straggler_rounds=%r of %r rounds%s"
+            % (sr, rounds, " — standing straggler" if standing else ""),
+            "(live)",
+        )
+    return rc, rows
+
+
+def format_rows(rows: List[dict]) -> str:
+    lines = []
+    for r in rows:
+        lines.append(
+            "%-4s %-18s %-24s %s"
+            % ("ok" if r["ok"] else "FAIL", r["family"], r["artifact"],
+               r["detail"])
+        )
+    fails = sum(1 for r in rows if not r["ok"])
+    lines.append(
+        "perf gate: %d check(s), %d failure(s)" % (len(rows), fails)
+    )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--check", action="store_true",
+        help="validate the newest committed artifact of every family "
+        "against its pinned bands (+ cross-artifact rules)",
+    )
+    ap.add_argument(
+        "--live", metavar="RUN.json", default=None,
+        help="fold a live RoundProfiler.summary() dump (or PROFILE_* "
+        "artifact) against the committed baselines",
+    )
+    ap.add_argument(
+        "--root", default=_REPO,
+        help="repo root holding the committed artifacts",
+    )
+    ap.add_argument(
+        "--tolerance", type=float, default=0.5,
+        help="--live round-time growth tolerance vs the committed "
+        "profile leg (0.5 = +50%%)",
+    )
+    ap.add_argument("--json", action="store_true",
+                    help="emit results as JSON rows")
+    args = ap.parse_args(argv)
+    if not args.check and not args.live:
+        ap.error("pass --check and/or --live RUN.json")
+    rc = 0
+    rows: List[dict] = []
+    if args.check:
+        c_rc, c_rows = check(args.root)
+        rc, rows = rc or c_rc, rows + c_rows
+    if args.live:
+        l_rc, l_rows = check_live(args.live, args.root, args.tolerance)
+        rc, rows = rc or l_rc, rows + l_rows
+    if args.json:
+        print(json.dumps(rows, indent=2))
+    else:
+        print(format_rows(rows))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,9 +4,13 @@ Input: a Chrome trace-event JSON written by ``--trace_out`` /
 ``obs.Tracer.save`` (or its sibling ``.jsonl`` structured run log —
 both carry the same spans).  Output: one row per span name with count,
 total/mean/p50/max milliseconds and the share of run wall time, plus an
-instant-event summary (faults, retries, quarantines) and the
-producer/consumer overlap audit — the numbers behind "is round r+1's
-assembly actually hidden under round r's execute?".
+instant-event summary (faults, retries, quarantines), the **measured
+producer hidden-fraction** — how much of the RoundFeed's assemble+h2d
+time ran under a different thread's execute/average spans, overall and
+per round (the offline sibling of ``obs/profile.py``'s live number) —
+and the compressed-collective breakdown (the PR-6 ``quantize`` /
+``allreduce`` / ``dequantize`` comm spans with their ``chunk=`` /
+``stage=`` / ``compress=`` arguments).
 
     python tools/trace_report.py RUN.trace.json
     python tools/trace_report.py RUN.trace.jsonl --json   # machine form
@@ -19,10 +23,13 @@ import json
 import sys
 from typing import Dict, List
 
+COMM_SPANS = ("quantize", "allreduce", "dequantize")
+
 
 def load_events(path: str) -> List[dict]:
     """Chrome-JSON or JSONL -> a uniform event list: spans as
-    {name, ts (us), dur (us), tid/thread}, instants as {name, ts}."""
+    {name, ts (us), dur (us), tid/thread, args}, instants as
+    {name, ts}."""
     if path.endswith(".jsonl"):
         events = []
         with open(path) as f:
@@ -39,11 +46,136 @@ def load_events(path: str) -> List[dict]:
                 }
                 if rec.get("kind") == "span":
                     ev["dur"] = float(rec.get("dur_ms", 0.0)) * 1e3
+                if rec.get("args"):
+                    ev["args"] = rec["args"]
                 events.append(ev)
         return events
     with open(path) as f:
         doc = json.load(f)
     return doc["traceEvents"] if isinstance(doc, dict) else doc
+
+
+def _merge_intervals(spans) -> List[tuple]:
+    """Sorted, non-overlapping (t0, t1) union of span intervals.
+    Consumer traces NEST execute inside average on one thread — summing
+    pairwise coverage over both would double-count, inflating the
+    hidden fraction up to 2x."""
+    ivs = sorted(
+        (s["ts"], s["ts"] + s["dur"]) for s in spans if s.get("dur")
+    )
+    merged: List[tuple] = []
+    for t0, t1 in ivs:
+        if merged and t0 <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], t1))
+        else:
+            merged.append((t0, t1))
+    return merged
+
+
+def _overlap_us(span, merged) -> float:
+    """Microseconds of ``span`` covered by ``merged`` (non-overlapping
+    sorted intervals from ``_merge_intervals`` — sum is exact)."""
+    a0, a1 = span["ts"], span["ts"] + span["dur"]
+    cov = 0.0
+    for o0, o1 in merged:
+        lo, hi = max(a0, o0), min(a1, o1)
+        if hi > lo:
+            cov += hi - lo
+    return min(cov, a1 - a0)
+
+
+def _hidden_fraction(by_name: Dict[str, List[dict]]) -> Dict[str, object]:
+    """Measured producer hidden-fraction: the share of assemble+h2d span
+    time overlapping a DIFFERENT thread's execute/average spans —
+    overall, and folded per round (``round=`` span args) into
+    p50/min/max.  Rounds whose producer work ran in the open (round 0,
+    the startup prefetch lead, a serial feed) honestly read 0."""
+    producers = by_name.get("assemble", []) + by_name.get("h2d", [])
+    consumers = by_name.get("execute", []) + by_name.get("average", [])
+    if not producers:
+        return {"producer_hidden_fraction": None,
+                "producer_hidden_fraction_per_round": None}
+    total = 0.0
+    hidden = 0.0
+    per_round: Dict[object, List[float]] = {}
+    merged_by_tid: Dict[object, List[tuple]] = {}
+    for p in producers:
+        tid = p.get("tid")
+        if tid not in merged_by_tid:
+            merged_by_tid[tid] = _merge_intervals(
+                c for c in consumers if c.get("tid") != tid
+            )
+        dur = p.get("dur", 0.0)
+        cov = _overlap_us(p, merged_by_tid[tid]) if dur else 0.0
+        total += dur
+        hidden += cov
+        r = (p.get("args") or {}).get("round")
+        acc = per_round.setdefault(r, [0.0, 0.0])
+        acc[0] += dur
+        acc[1] += cov
+    overall = hidden / total if total > 0 else None
+    fracs = sorted(
+        cov / dur for dur, cov in per_round.values() if dur > 0
+    )
+    per = None
+    if fracs:
+        per = {
+            "rounds": len(fracs),
+            "p50": round(fracs[len(fracs) // 2], 4),
+            "min": round(fracs[0], 4),
+            "max": round(fracs[-1], 4),
+        }
+    return {
+        "producer_hidden_fraction": (
+            round(overall, 4) if overall is not None else None
+        ),
+        "producer_hidden_fraction_per_round": per,
+    }
+
+
+def _comm_section(by_name: Dict[str, List[dict]]) -> Dict[str, object]:
+    """The compressed-collective breakdown (PR-6 comm spans), absent
+    (None) for traces that predate the comm plane."""
+    if not any(by_name.get(n) for n in COMM_SPANS):
+        return {"comm": None}
+    out: Dict[str, object] = {}
+    ar = by_name.get("allreduce", [])
+    if ar:
+        chunks = sorted(
+            {(e.get("args") or {}).get("chunk") for e in ar}
+            - {None}
+        )
+        out["allreduce"] = {
+            "count": len(ar),
+            "total_ms": round(sum(e["dur"] for e in ar) / 1e3, 3),
+            "chunks": chunks,
+            "nbytes_total": int(sum(
+                (e.get("args") or {}).get("nbytes", 0) for e in ar
+            )),
+            "threads": sorted({str(e.get("tid")) for e in ar}),
+        }
+    qz = by_name.get("quantize", [])
+    if qz:
+        out["quantize"] = {
+            "count": len(qz),
+            "total_ms": round(sum(e["dur"] for e in qz) / 1e3, 3),
+            "compress": sorted(
+                {(e.get("args") or {}).get("compress") for e in qz}
+                - {None}
+            ),
+        }
+    dq = by_name.get("dequantize", [])
+    if dq:
+        stages: Dict[str, int] = {}
+        for e in dq:
+            s = (e.get("args") or {}).get("stage", "?")
+            stages[s] = stages.get(s, 0) + 1
+        out["dequantize"] = {
+            "count": len(dq),
+            "total_ms": round(sum(e["dur"] for e in dq) / 1e3, 3),
+            "stages": dict(sorted(stages.items())),
+        }
+    return {"comm": out}
 
 
 def fold(events: List[dict]) -> Dict[str, object]:
@@ -73,25 +205,18 @@ def fold(events: List[dict]) -> Dict[str, object]:
     inst_counts: Dict[str, int] = {}
     for e in instants:
         inst_counts[e["name"]] = inst_counts.get(e["name"], 0) + 1
-    # overlap audit: any producer-thread assemble/h2d span intersecting
-    # a different thread's execute span in time
-    overlap = False
-    execs = by_name.get("execute", [])
-    for a in by_name.get("assemble", []) + by_name.get("h2d", []):
-        for x in execs:
-            if a["tid"] != x["tid"] and (
-                a["ts"] < x["ts"] + x["dur"] and x["ts"] < a["ts"] + a["dur"]
-            ):
-                overlap = True
-                break
-        if overlap:
-            break
-    return {
+    rep = {
         "wall_ms": round(wall_us / 1e3, 3),
         "phases": phases,
         "instants": dict(sorted(inst_counts.items())),
-        "producer_overlap_observed": overlap,
     }
+    rep.update(_hidden_fraction(by_name))
+    # back-compat boolean (OBS_r09 schema): derived from the measured
+    # fraction instead of a separate any-overlap scan
+    hf = rep["producer_hidden_fraction"]
+    rep["producer_overlap_observed"] = bool(hf is not None and hf > 0)
+    rep.update(_comm_section(by_name))
+    return rep
 
 
 def format_report(rep: Dict[str, object]) -> str:
@@ -113,10 +238,44 @@ def format_report(rep: Dict[str, object]) -> str:
             "instants: "
             + ", ".join(f"{k} x{v}" for k, v in rep["instants"].items())
         )
-    lines.append(
-        "producer assembly/h2d overlapping consumer execute: %s"
-        % ("YES" if rep["producer_overlap_observed"] else "no")
-    )
+    hf = rep.get("producer_hidden_fraction")
+    per = rep.get("producer_hidden_fraction_per_round")
+    if hf is None:
+        lines.append("producer assembly/h2d hidden under execute: n/a")
+    else:
+        lines.append(
+            "producer assembly/h2d hidden under execute: %.1f%%%s"
+            % (
+                100.0 * hf,
+                " (per round: p50 %.2f, min %.2f, max %.2f over %d)"
+                % (per["p50"], per["min"], per["max"], per["rounds"])
+                if per else "",
+            )
+        )
+    comm = rep.get("comm")
+    if comm:
+        ar = comm.get("allreduce")
+        if ar:
+            lines.append(
+                "compressed collective: allreduce x%d %.1f ms over "
+                "chunks %s (%d B modeled)"
+                % (
+                    ar["count"], ar["total_ms"], ar["chunks"],
+                    ar["nbytes_total"],
+                )
+            )
+        for name in ("quantize", "dequantize"):
+            sec = comm.get(name)
+            if sec:
+                extra = (
+                    " modes %s" % sec["compress"]
+                    if name == "quantize"
+                    else " stages %s" % sec["stages"]
+                )
+                lines.append(
+                    "  %s x%d %.1f ms%s"
+                    % (name, sec["count"], sec["total_ms"], extra)
+                )
     return "\n".join(lines)
 
 
