@@ -38,17 +38,23 @@ CROP_SIZE = 227  # croppedHeight/croppedWidth, ImageNetApp.scala:25-26
 def load_minibatch_partitions(
     loader, prefix: str, labels_file: str, n_workers: int, batch: int,
     height: int, width: int, keep: slice = slice(None),
+    epoch=None, shuffle_seed: int = 0,
 ):
     """Partition shards over workers and pack each partition into uint8
     minibatches (materialized — performance is best if the data fits in
     memory, same caveat as the reference app's .persist()).  ``keep``
     selects which workers' partitions to materialize — a multi-host run
     loads only its own block while every host agrees on the global
-    partitioning."""
+    partitioning.  ``epoch`` routes shard ownership through the
+    cross-epoch shuffle-by-assignment service (``data/shuffle.py``);
+    None keeps the legacy round-robin deal."""
     from sparknet_tpu.data import ScaleAndConvert
 
     conv = ScaleAndConvert(batch, height, width)
-    parts = loader.partitions(prefix, labels_file, num_parts=n_workers)
+    parts = loader.partitions(
+        prefix, labels_file, num_parts=n_workers,
+        epoch=epoch, shuffle_seed=shuffle_seed,
+    )
     out = []
     for w, part in enumerate(parts):
         if keep != slice(None) and not (keep.start <= w < keep.stop):
@@ -83,6 +89,28 @@ def main(argv=None) -> int:
         "--serial_feed", action="store_true",
         help="disable the pipelined round feed (assemble+H2D on the "
         "training loop) — for relay-degraded links (PERF.md)",
+    )
+    parser.add_argument(
+        "--cache_dir", default=None,
+        help="front the object store with the host-local content-"
+        "addressed chunk cache rooted here (data/chunk_cache.py): "
+        "epoch 1 fills it, later epochs read local disk — multi-epoch "
+        "runs go I/O-flat (only meaningful when --data is a "
+        "gs://|s3://|http(s)://|file:// url)",
+    )
+    parser.add_argument(
+        "--cache_bytes", default="0",
+        help="chunk-cache LRU byte budget, e.g. 512M / 8G "
+        "(0 = unbounded)",
+    )
+    parser.add_argument(
+        "--shuffle_epochs", type=int, default=0,
+        help="split --rounds into N epochs and reshuffle shard->worker "
+        "ownership between them via the seeded shuffle-by-assignment "
+        "service (data/shuffle.py): a global reshuffle moves only the "
+        "assignment table, and with --cache_dir the repeat reads hit "
+        "the local cache (0/1 = single fixed assignment, the legacy "
+        "behavior)",
     )
     from sparknet_tpu import obs
     from sparknet_tpu.parallel import comm
@@ -158,12 +186,36 @@ def main(argv=None) -> int:
     mesh = make_mesh({"dp": n_workers}, devices=jax.devices()[:n_workers])
     mine = local_worker_slice(mesh) if distributed else slice(0, n_workers)
 
-    loader = ImageNetLoader(data_dir)
-    log.log("loading train data")
-    train_parts = load_minibatch_partitions(
-        loader, args.train_prefix, args.train_labels, n_workers,
-        args.train_batch, args.full_size, args.full_size, keep=mine,
+    from sparknet_tpu.data import chunk_cache
+
+    loader = ImageNetLoader(
+        data_dir,
+        cache_dir=args.cache_dir,
+        cache_bytes=chunk_cache.parse_bytes(args.cache_bytes),
     )
+    if loader.cache is not None:
+        log.log(
+            f"chunk cache at {loader.cache.root} "
+            f"(budget {loader.cache.byte_budget or 'unbounded'} bytes)"
+        )
+    # cross-epoch shuffle-by-assignment: --shuffle_epochs N splits the
+    # run into N epochs; each epoch's shard->worker ownership is a
+    # seeded permutation pure in (seed, epoch) — the reshuffle moves
+    # only the assignment table, and repeat reads hit the chunk cache
+    shuffle_on = args.shuffle_epochs > 1
+    rounds_per_epoch = (
+        -(-args.rounds // args.shuffle_epochs) if shuffle_on else None
+    )
+
+    def load_train_parts(epoch):
+        return load_minibatch_partitions(
+            loader, args.train_prefix, args.train_labels, n_workers,
+            args.train_batch, args.full_size, args.full_size, keep=mine,
+            epoch=epoch, shuffle_seed=args.seed,
+        )
+
+    log.log("loading train data")
+    train_parts = load_train_parts(0 if shuffle_on else None)
     log.log("loading test data")
     test_parts = load_minibatch_partitions(
         loader, args.test_prefix, args.test_labels, n_workers,
@@ -217,24 +269,41 @@ def main(argv=None) -> int:
         )
     else:
         mean = reduce_mean_sums(local_sums)
-    mean_path = os.path.join(data_dir, "mean.binaryproto")
+    # a bucket/HTTP data root is not writable from here: the mean
+    # artifact lands next to the cache (or a temp dir) instead
+    from sparknet_tpu.data import object_store
+
+    if object_store.is_object_store_url(data_dir):
+        mean_dir = (
+            loader.cache.root if loader.cache is not None
+            else tempfile.mkdtemp(prefix="imagenet_mean_")
+        )
+    else:
+        mean_dir = data_dir
+    mean_path = os.path.join(mean_dir, "mean.binaryproto")
     save_mean_image(mean, mean_path)
     log.log(f"mean image -> {mean_path}")
 
     # per-worker samplers over that worker's partition (contiguous random
     # window of tau per round, MinibatchSampler semantics); seeds keyed by
     # GLOBAL worker index so a multi-host run draws like a 1-host run
-    samplers = [
-        MinibatchSampler(
-            {
-                "data": np.stack([mb[0] for mb in part]),
-                "label": np.stack([mb[1].astype(np.float32) for mb in part]),
-            },
-            num_sampled_batches=args.tau,
-            seed=args.seed + mine.start + i,
-        )
-        for i, part in enumerate(train_parts)
-    ]
+    # (and by epoch, so a reshuffled epoch draws fresh windows)
+    def build_samplers(parts, epoch=0):
+        return [
+            MinibatchSampler(
+                {
+                    "data": np.stack([mb[0] for mb in part]),
+                    "label": np.stack(
+                        [mb[1].astype(np.float32) for mb in part]
+                    ),
+                },
+                num_sampled_batches=args.tau,
+                seed=args.seed + mine.start + i + 7919 * epoch,
+            )
+            for i, part in enumerate(parts)
+        ]
+
+    samplers = build_samplers(train_parts)
     # test batches: heterogeneous per-worker counts, pad-and-mask — every
     # minibatch is scored even when val shards split unevenly
     test_batches, test_counts = ParameterAveragingTrainer.pad_partitions(
@@ -314,13 +383,38 @@ def main(argv=None) -> int:
     # into recycled buffers and device_put on a producer thread while
     # round r executes (--serial_feed restores the serial path)
     run_obs = obs.start_from_args(args, echo=log.log)
+    # epoch switching runs on the feed's producer thread (assemble is
+    # called once per round, in order): at an epoch boundary the shard
+    # assignment re-deals and the partitions reload — through the chunk
+    # cache those reloads are local-disk hits, overlapped under the
+    # previous round's execute like any other assembly work
+    sampler_state = {"epoch": 0, "samplers": samplers}
+
+    def draw_windows(r):
+        if shuffle_on:
+            e = min(r // rounds_per_epoch, args.shuffle_epochs - 1)
+            if e != sampler_state["epoch"]:
+                parts = load_train_parts(e)
+                if min(len(p) for p in parts) < args.tau:
+                    raise RuntimeError(
+                        f"epoch {e}: a worker's reshuffled partition has "
+                        f"fewer than tau={args.tau} minibatches; sizes "
+                        f"{[len(p) for p in parts]}"
+                    )
+                sampler_state["samplers"] = build_samplers(parts, e)
+                sampler_state["epoch"] = e
+                log.log(
+                    f"epoch {e}: shard ownership reshuffled "
+                    "(shuffle-by-assignment; repeat reads served by the "
+                    "chunk cache)", i=r,
+                )
+        return [s.next_window for s in sampler_state["samplers"]]
+
     # timed_worker_windows: with --profile the per-worker draw times
     # feed the round profiler's straggler attribution
     feed = RoundFeed(
         lambda r, out: stack_windows(
-            obs.profile.timed_worker_windows(
-                r, [s.next_window for s in samplers]
-            ),
+            obs.profile.timed_worker_windows(r, draw_windows(r)),
             out,
         ),
         place=lambda host: shard_leading_global(host, mesh),

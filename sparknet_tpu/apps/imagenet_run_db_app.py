@@ -56,6 +56,27 @@ def main(argv=None) -> int:
         help="disable the pipelined round feed (PERF.md: relay-degraded "
         "links)",
     )
+    parser.add_argument(
+        "--cache_dir", default=None,
+        help="when --db_dir is a gs://|s3://|http(s)://|file:// url, "
+        "stage the DB files through the host-local content-addressed "
+        "chunk cache rooted here (data/chunk_cache.py) — a restarted "
+        "run re-verifies local bytes instead of re-downloading",
+    )
+    parser.add_argument(
+        "--cache_bytes", default="0",
+        help="chunk-cache LRU byte budget, e.g. 512M / 8G "
+        "(0 = unbounded)",
+    )
+    parser.add_argument(
+        "--shuffle_epochs", type=int, default=0,
+        help="split --rounds into N epochs and re-permute which worker "
+        "reads which train DB shard between them (seeded shuffle-by-"
+        "assignment, data/shuffle.py) — no bytes move, only the "
+        "worker->shard table (0/1 = fixed assignment; resumes must "
+        "pass the same --rounds/--shuffle_epochs for stable epoch "
+        "boundaries)",
+    )
     from sparknet_tpu import obs
     from sparknet_tpu.parallel import comm
 
@@ -79,8 +100,49 @@ def main(argv=None) -> int:
     from sparknet_tpu.utils import TrainingLog
 
     log = TrainingLog(tag="imagenet_run_db")
-    info_path = os.path.join(args.db_dir, "imagenet_db_info.json")
-    with open(info_path) as f:
+    # --db_dir may be an object-store url: the DB files stage through
+    # the chunk cache to verified local paths (CRC-manifested, atomic,
+    # quarantine-on-corruption) — phase 2 runs straight off a bucket,
+    # and a restart re-verifies local bytes instead of re-downloading
+    from sparknet_tpu.data import object_store
+
+    remote_db = object_store.is_object_store_url(args.db_dir)
+    if remote_db:
+        import tempfile
+
+        from sparknet_tpu.data import chunk_cache
+
+        if (
+            args.cache_dir is None
+            and args.snapshot_prefix is None
+            and (args.resume or args.snapshot_every)
+        ):
+            # snapshots would land in a fresh temp cache dir that the
+            # NEXT invocation cannot find — --resume would report "no
+            # snapshots" while valid ones sit stranded in /tmp
+            raise SystemExit(
+                "imagenet_run_db: a remote --db_dir with "
+                "--snapshot_every/--resume needs a stable --cache_dir "
+                "or an explicit --snapshot_prefix (snapshots in a "
+                "temp-dir cache would be unfindable on restart)"
+            )
+        cache_root = args.cache_dir or tempfile.mkdtemp(
+            prefix="sparknet_db_cache_"
+        )
+        _store = object_store.open_store(args.db_dir)
+        _cache = chunk_cache.ChunkCache(
+            cache_root, byte_budget=chunk_cache.parse_bytes(args.cache_bytes)
+        )
+        log.log(f"staging {args.db_dir} through chunk cache {cache_root}")
+
+        def db_path(name: str) -> str:
+            return _cache.local_path(_store, name)
+    else:
+
+        def db_path(name: str) -> str:
+            return os.path.join(args.db_dir, name)
+
+    with open(db_path("imagenet_db_info.json")) as f:
         info = json.load(f)
     n_workers = int(info["workers"])
     full = int(info["full_size"])
@@ -90,14 +152,14 @@ def main(argv=None) -> int:
     num_test_mbs = int(sum(info["test_batches"]))
 
     mean = caffemodel.load_mean_image(
-        os.path.join(args.db_dir, "imagenet_mean.binaryproto")
+        db_path("imagenet_mean.binaryproto")
     )
 
     # per-worker native pipelines: train crops randomly + mirrors, test
     # center-crops — DataTransformer semantics in the reader thread
     pipes = [
         runtime.DataPipeline(
-            os.path.join(args.db_dir, f"ilsvrc12_train_db_{w}.sndb"),
+            db_path(f"ilsvrc12_train_db_{w}.sndb"),
             batch_size=int(info["train_batch"]),
             shape=(3, full, full),
             crop=crop,
@@ -110,7 +172,7 @@ def main(argv=None) -> int:
     ]
     test_pipes = [
         runtime.DataPipeline(
-            os.path.join(args.db_dir, f"ilsvrc12_val_db_{w}.sndb"),
+            db_path(f"ilsvrc12_val_db_{w}.sndb"),
             batch_size=int(info["test_batch"]),
             shape=(3, full, full),
             crop=crop,
@@ -146,7 +208,9 @@ def main(argv=None) -> int:
     )
     state = trainer.init_state(seed=args.seed)
 
-    prefix = args.snapshot_prefix or os.path.join(args.db_dir, "imagenet_db")
+    prefix = args.snapshot_prefix or os.path.join(
+        cache_root if remote_db else args.db_dir, "imagenet_db"
+    )
     if sentry is not None:
         sentry.restore_fn = health_mod.make_restore_fn(
             solver, prefix, trainer=trainer
@@ -199,12 +263,31 @@ def main(argv=None) -> int:
         )
         return primary_accuracy(scores) / max(1, num_test_mbs)
 
+    # cross-epoch shuffle-by-assignment (--shuffle_epochs): worker w
+    # reads train shard perm[w] for the epoch — a seeded permutation
+    # pure in (seed, epoch), derived from the ABSOLUTE round index so a
+    # resumed run re-derives the same table.  No bytes move; only the
+    # worker->shard assignment.
+    shuffle_on = args.shuffle_epochs > 1
+    rounds_per_epoch = (
+        -(-args.rounds // args.shuffle_epochs) if shuffle_on else None
+    )
+
+    def pipe_order(r):
+        if not shuffle_on:
+            return range(n_workers)
+        from sparknet_tpu.data import shuffle as shuffle_mod
+
+        e = min(r // rounds_per_epoch, args.shuffle_epochs - 1)
+        return shuffle_mod.permutation(n_workers, args.seed, e)
+
     def assemble(r, out):
         # worker_timer: with --profile each worker's DB pull time feeds
         # the round profiler's straggler attribution (no-op otherwise)
         windows = []
-        for w, pipe in enumerate(pipes):
-            with obs.profile.worker_timer(r, w, len(pipes)):
+        for w, p in enumerate(pipe_order(r)):
+            pipe = pipes[p]
+            with obs.profile.worker_timer(r, w, n_workers):
                 batches = [pipe.next() for _ in range(args.tau)]
                 windows.append(
                     {

@@ -11,6 +11,11 @@ Replaces the reference's two data paths with one idiomatic TPU pattern:
 """
 
 from sparknet_tpu.data.cifar import CifarLoader  # noqa: F401
+from sparknet_tpu.data.chunk_cache import (  # noqa: F401
+    CachingStore,
+    ChunkCache,
+)
+from sparknet_tpu.data import shuffle  # noqa: F401
 from sparknet_tpu.data.imagenet import (  # noqa: F401
     ImageNetLoader,
     ScaleAndConvert,

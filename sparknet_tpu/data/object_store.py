@@ -32,7 +32,7 @@ import json
 import urllib.error
 import urllib.parse
 import urllib.request
-from typing import Callable, List, Optional
+from typing import Callable, List, Optional, Tuple
 
 from sparknet_tpu.utils import retry as _retry
 
@@ -49,7 +49,7 @@ def set_fault_hook(hook: Optional[Callable[[str], None]]) -> None:
 
 
 def is_object_store_url(root: str) -> bool:
-    return root.startswith(("gs://", "s3://", "http://", "https://"))
+    return root.startswith(("gs://", "s3://", "http://", "https://", "file://"))
 
 
 def open_store(root: str) -> "ObjectStore":
@@ -59,12 +59,35 @@ def open_store(root: str) -> "ObjectStore":
         return S3Store(root)
     if root.startswith(("http://", "https://")):
         return HTTPStore(root)
+    if root.startswith("file://"):
+        return LocalStore(root)
     raise ValueError(f"not an object-store url: {root!r}")
+
+
+class _MidStreamFailure(Exception):
+    """Internal: the body read died AFTER a successful open() (reset /
+    short body).  Tagging it lets ``read_with_info``'s retry loop
+    re-fetch the object without re-entering ``open()``'s own retry
+    budget for plain connection failures."""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(str(cause))
+        self.cause = cause
+
+
+def _midstream_retryable(exc: BaseException) -> bool:
+    return isinstance(exc, _MidStreamFailure) and _retry.is_retryable(
+        exc.cause
+    )
 
 
 class ObjectStore:
     """list(prefix) -> relative object names; open(name) -> streaming
-    binary file object; read(name) -> bytes."""
+    binary file object; read(name) -> bytes.  Subclasses set ``url``
+    (the root the store was opened with) — the chunk cache's content-
+    address key (``data/chunk_cache.py``)."""
+
+    url: str = ""
 
     def list(self, prefix: str = "") -> List[str]:
         raise NotImplementedError
@@ -73,8 +96,42 @@ class ObjectStore:
         raise NotImplementedError
 
     def read(self, name: str) -> bytes:
-        with self.open(name) as f:
-            return f.read()
+        """Whole-object bytes, surviving MID-STREAM failures: ``open()``
+        retries the connection, but a reset/truncation during the body
+        read after a 200 used to propagate.  Here the whole
+        open-and-drain attempt sits under one retry budget with the
+        shared transient/permanent classification (``utils/retry.py``)
+        — a connection that dies mid-body re-fetches the object."""
+        return self.read_with_info(name)[0]
+
+    def read_with_info(self, name: str) -> "Tuple[bytes, Optional[str]]":
+        """(bytes, etag-or-None) with the same mid-stream retry
+        contract as ``read`` — the chunk cache records the fetch-time
+        ETag in its entry manifest.
+
+        Retry layering: connection-level failures are ``open()``'s job
+        (the HTTP stores' ``_get`` runs its own backoff loop); the loop
+        HERE retries only failures of the body read after a successful
+        open.  An open() failure propagates as-is — re-entering it from
+        this loop would multiply the two retry budgets."""
+
+        def attempt():
+            f = self.open(name)  # its own retry budget; failures propagate
+            try:
+                with f:
+                    data = f.read()
+            except Exception as e:
+                raise _MidStreamFailure(e) from e
+            headers = getattr(f, "headers", None)
+            etag = headers.get("ETag") if headers is not None else None
+            return data, etag.strip('"') if etag else None
+
+        try:
+            return _retry.retry_call(
+                attempt, retryable=_midstream_retryable
+            )
+        except _MidStreamFailure as e:
+            raise e.cause  # non-retryable mid-stream error, unwrapped
 
 
 def _get(
@@ -123,6 +180,7 @@ class GCSStore(ObjectStore):
     def __init__(self, root: str, endpoint: str = None):
         import os
 
+        self.url = root
         self._u = _SplitUrl(root, "gs://")
         # SPARKNET_GCS_ENDPOINT supports emulators/proxies (and tests)
         self._ep = endpoint or os.environ.get(
@@ -163,6 +221,7 @@ class S3Store(ObjectStore):
     def __init__(self, root: str, endpoint: str = None):
         import os
 
+        self.url = root
         self._u = _SplitUrl(root, "s3://")
         self._ep = endpoint or os.environ.get(
             "SPARKNET_S3_ENDPOINT",
@@ -170,6 +229,7 @@ class S3Store(ObjectStore):
         )
 
     def list(self, prefix: str = "") -> List[str]:
+        import html
         import re
 
         full = self._u.full_key(prefix)
@@ -182,7 +242,12 @@ class S3Store(ObjectStore):
             with _get(f"{self._ep}/?{urllib.parse.urlencode(q)}") as r:
                 body = r.read().decode("utf-8", "replace")
             for key in re.findall(r"<Key>([^<]+)</Key>", body):
-                name = key
+                # ListObjectsV2 bodies are XML: keys containing &, <,
+                # quotes (or, with encoding-type=url nowhere in play,
+                # any &#NN; reference) arrive ESCAPED — served verbatim
+                # they 404 on fetch.  html.unescape covers the XML
+                # predefined entities plus numeric references.
+                name = html.unescape(key)
                 if self._u.prefix:
                     name = name[len(self._u.prefix) + 1 :]
                 out.append(name)
@@ -191,7 +256,9 @@ class S3Store(ObjectStore):
             )
             if not m:
                 return sorted(out)
-            token = m.group(1)
+            # continuation tokens are XML text too (base64-ish but AWS
+            # documents no alphabet — unescape defensively)
+            token = html.unescape(m.group(1))
 
     def open(self, name: str):
         key = urllib.parse.quote(self._u.full_key(name))
@@ -212,6 +279,7 @@ class _HrefParser(html.parser.HTMLParser):
 
 class HTTPStore(ObjectStore):
     def __init__(self, root: str):
+        self.url = root
         self._root = root.rstrip("/")
 
     def list(self, prefix: str = "") -> List[str]:
@@ -232,3 +300,34 @@ class HTTPStore(ObjectStore):
 
     def open(self, name: str):
         return _get(f"{self._root}/{urllib.parse.quote(name)}")
+
+
+class LocalStore(ObjectStore):
+    """``file://`` roots behind the same ObjectStore surface — local
+    fixtures (the chaos harness's chunk store) and mounted datasets get
+    the uniform list/open/read API, including the cache front."""
+
+    def __init__(self, root: str):
+        import os
+
+        self.url = root
+        path = root[len("file://"):] if root.startswith("file://") else root
+        self._root = os.path.abspath(path)
+
+    def list(self, prefix: str = "") -> List[str]:
+        import os
+
+        out: List[str] = []
+        for dirpath, _, files in os.walk(self._root):
+            for fname in files:
+                rel = os.path.relpath(
+                    os.path.join(dirpath, fname), self._root
+                )
+                if rel.startswith(prefix):
+                    out.append(rel)
+        return sorted(out)
+
+    def open(self, name: str):
+        import os
+
+        return open(os.path.join(self._root, name), "rb")

@@ -173,6 +173,28 @@ class TrainingMetrics:
             "chaos-injected faults observed, by kind",
             labels=("kind",),
         )
+        # chunk-cache series (data/chunk_cache.py, --cache_dir) — zero
+        # until a run fronts its object store with a ChunkCache
+        self.cache_hits = registry.counter(
+            "sparknet_cache_hits_total",
+            "chunk-cache reads served from verified local entries",
+        )
+        self.cache_misses = registry.counter(
+            "sparknet_cache_misses_total",
+            "chunk-cache reads that fetched from the backing object "
+            "store (cold, evicted, stale-etag, or quarantined entries)",
+        )
+        self.cache_evictions = registry.counter(
+            "sparknet_cache_evictions_total",
+            "chunk-cache entries LRU-evicted at the byte budget",
+        )
+        self.cache_bytes = registry.counter(
+            "sparknet_cache_bytes_total",
+            "bytes served through the chunk cache, by source "
+            "(hit = local disk, miss = network fetch); an I/O-flat "
+            "multi-epoch run's miss series goes flat after epoch 1",
+            labels=("src",),
+        )
         self.collective_bytes = registry.counter(
             "sparknet_collective_bytes_total",
             "modeled interconnect payload bytes moved by the parameter-"

@@ -30,6 +30,14 @@ real TPU pod into a small cifar10_quick run on the virtual mesh —
   to EXACTLY the seeded worker (per-worker timing hooks + straggler
   verdict) — the signal ROADMAP item 1's elastic membership needs to
   know *which* worker to evict.
+- **cache corruption**: the chunk cache's published entry for a seeded
+  round's data chunk is byte-flipped on disk (size unchanged — only
+  the CRC manifest can catch it); the cache must QUARANTINE the entry
+  (``*.corrupt``) and transparently refetch byte-identical data from
+  the backing store (``data/chunk_cache.py``).
+- **cache cold**: the whole cache is wiped at a seeded round (host
+  restart / cache-volume loss stand-in); the read must miss, refetch,
+  and training must not notice.
 
 Every fault is counted as injected and (when the run recovers) survived;
 ``bench.py --mode=chaos`` emits the ``CHAOS_r07.json`` artifact
@@ -106,6 +114,19 @@ class FaultPlan:
     straggler_round: Optional[int] = 1
     straggler_worker: int = 3
     straggler_s: float = 0.4
+    # cache_corruption: byte-flip the chunk cache's PUBLISHED entry for
+    # this round's data chunk before the read (fires once, absolute
+    # round).  Survived = the cache quarantined the entry (*.corrupt on
+    # disk), refetched from the backing store, and served bytes
+    # IDENTICAL to a direct store read.  Before the preemption so the
+    # resume replay cannot re-fire it.
+    cache_corrupt_round: Optional[int] = 2
+    # cache_cold: wipe every published cache entry before this round's
+    # read (a host restart / lost cache volume).  Survived = the read
+    # misses, refetches, and the round trains normally.  AFTER the
+    # preemption: the cold-cache recovery is exercised on the resumed
+    # process, the realistic case.
+    cache_cold_round: Optional[int] = 5
 
     @classmethod
     def default(cls) -> "FaultPlan":
@@ -122,6 +143,8 @@ class FaultPlan:
             dead_worker=None,
             nan_round=None,
             straggler_round=None,
+            cache_corrupt_round=None,
+            cache_cold_round=None,
         )
 
 
@@ -156,6 +179,38 @@ def storage_fault_hook(plan: FaultPlan, counters: Dict[str, int]):
     return hook
 
 
+def chunk_name(r: int) -> str:
+    """The chunk-store object name for round ``r``'s window."""
+    return f"round_{r:04d}.npz"
+
+
+def write_round_chunks(plan: FaultPlan, xs, ys, chunk_dir: str) -> None:
+    """Serialize every round's CLEAN window arrays (the same index math
+    ``_Feed`` uses) as npz chunks in a local store directory — the
+    backing objects the chunk cache fronts during the chaos run.
+    Idempotent; files publish atomically."""
+    import io as _io
+
+    os.makedirs(chunk_dir, exist_ok=True)
+    W, tau, B, n = plan.workers, plan.tau, plan.batch, len(xs)
+    for r in range(plan.rounds):
+        path = os.path.join(chunk_dir, chunk_name(r))
+        if os.path.exists(path):
+            continue
+        data = np.empty((W, tau) + xs[0].shape, np.float32)
+        label = np.empty((W, tau, B), np.float32)
+        for w in range(W):
+            for t in range(tau):
+                i = (r * W * tau + w * tau + t) % n
+                data[w, t] = xs[i]
+                label[w, t] = ys[i]
+        from sparknet_tpu.data.chunk_cache import atomic_write_bytes
+
+        buf = _io.BytesIO()
+        np.savez(buf, data=data, label=label)
+        atomic_write_bytes(path, buf.getvalue())
+
+
 def corrupt_file(path: str, seed: int = 0) -> None:
     """Flip a run of bytes in the middle of ``path`` (size unchanged —
     only a checksum can catch it; truncation is the easy case)."""
@@ -181,12 +236,18 @@ class _Feed:
     stalls (producer wedges past the watchdog) injected per plan."""
 
     def __init__(self, plan: FaultPlan, xs, ys, counters, events, mesh,
-                 fault_state=None):
+                 fault_state=None, chunk_source=None):
         self.plan = plan
         self.xs, self.ys = xs, ys
         self.counters = counters
         self.events = events
         self.mesh = mesh
+        # chunk_source: (store, cache) — the round windows then arrive
+        # as npz chunks read THROUGH the content-addressed chunk cache
+        # (data/chunk_cache.py), which is what the cache_corruption /
+        # cache_cold faults attack.  None keeps the direct in-memory
+        # build (unit tests).
+        self._store, self._cache = chunk_source or (None, None)
         # fault state is SHARED across prefetcher/feed rebuilds (resume
         # replays rounds by absolute index; a per-round fault fires once)
         fault_state = fault_state if fault_state is not None else {}
@@ -200,18 +261,96 @@ class _Feed:
             "stragglers",
             set() if plan.straggler_round is None else {plan.straggler_round},
         )
+        fault_state.setdefault(
+            "cache_corrupts",
+            set() if plan.cache_corrupt_round is None
+            else {plan.cache_corrupt_round},
+        )
+        fault_state.setdefault(
+            "cache_colds",
+            set() if plan.cache_cold_round is None
+            else {plan.cache_cold_round},
+        )
         self._faults = fault_state["faults"]
         self._stalls = fault_state["stalls"]
         self._nans = fault_state["nans"]
         self._stragglers = fault_state["stragglers"]
+        self._cache_corrupts = fault_state["cache_corrupts"]
+        self._cache_colds = fault_state["cache_colds"]
         self._rf = None
         self._policy = _retry.RetryPolicy(
             max_attempts=6, base_s=0.005, cap_s=0.02, budget_s=2.0
         )
 
+    def _chunk_arrays(self, r: int):
+        """Round ``r``'s clean window arrays read THROUGH the chunk
+        cache, with the seeded cache faults applied first.  The
+        corruption verdict requires all three: quarantine evidence on
+        disk, a transparent refetch, and bytes identical to a direct
+        store read."""
+        import io as _io
+
+        name = chunk_name(r)
+        if r in self._cache_corrupts:
+            self._cache_corrupts.discard(r)
+            # ensure the entry is published, then flip bytes in the
+            # PUBLISHED chunk (size unchanged — only the CRC32 in the
+            # entry manifest can catch it)
+            self._cache.get(self._store, name)
+            entry = self._cache.entry_path(self._store.url, name)
+            corrupt_file(entry, seed=self.plan.seed)
+            self.counters["cache_corrupt_injected"] = (
+                self.counters.get("cache_corrupt_injected", 0) + 1
+            )
+            self.events.append(
+                f"round {r}: cache entry for {name} byte-flipped on disk"
+            )
+            _obs.fault("cache_corruption", round=r, chunk=name)
+            q_before = self._cache.stats["quarantined"]
+            blob = self._cache.get(self._store, name)
+            direct = self._store.read(name)
+            if (
+                self._cache.stats["quarantined"] == q_before + 1
+                and blob == direct
+            ):
+                self.counters["cache_corrupt_survived"] = (
+                    self.counters.get("cache_corrupt_survived", 0) + 1
+                )
+                self.events.append(
+                    f"round {r}: cache quarantined the corrupt entry "
+                    "(*.corrupt) and refetched byte-identical data"
+                )
+                _obs.instant("recovered", kind="cache_corruption", round=r)
+        elif r in self._cache_colds:
+            self._cache_colds.discard(r)
+            dropped = self._cache.clear()
+            self.counters["cache_cold_injected"] = (
+                self.counters.get("cache_cold_injected", 0) + 1
+            )
+            self.events.append(
+                f"round {r}: cache wiped cold ({dropped} entries dropped)"
+            )
+            _obs.fault("cache_cold", round=r, entries_dropped=dropped)
+            m_before = self._cache.stats["misses"]
+            blob = self._cache.get(self._store, name)
+            if self._cache.stats["misses"] == m_before + 1:
+                self.counters["cache_cold_survived"] = (
+                    self.counters.get("cache_cold_survived", 0) + 1
+                )
+                self.events.append(
+                    f"round {r}: cold read missed and refetched from "
+                    "the backing store"
+                )
+                _obs.instant("recovered", kind="cache_cold", round=r)
+        else:
+            blob = self._cache.get(self._store, name)
+        with np.load(_io.BytesIO(blob)) as z:
+            return z["data"], z["label"]
+
     def _build(self, r: int):
         p, W, tau, B = self.plan, self.plan.workers, self.plan.tau, self.plan.batch
         n = len(self.xs)
+        src = self._chunk_arrays(r) if self._cache is not None else None
         straggle = None
         if r in self._stragglers:
             # straggler_injection: the planned worker's assembly sleeps
@@ -238,10 +377,16 @@ class _Feed:
             t0 = time.perf_counter()
             if straggle == w:
                 time.sleep(self.plan.straggler_s)
-            for t in range(tau):
-                i = (r * W * tau + w * tau + t) % n
-                data[w, t] = self.xs[i]
-                label[w, t] = self.ys[i]
+            if src is not None:
+                # chunk path: the same arrays, via the cached chunk
+                # (per-worker copy keeps the timing attribution honest)
+                data[w] = src[0][w]
+                label[w] = src[1][w]
+            else:
+                for t in range(tau):
+                    i = (r * W * tau + w * tau + t) % n
+                    data[w, t] = self.xs[i]
+                    label[w, t] = self.ys[i]
             worker_s.append(time.perf_counter() - t0)
         # per-worker assemble attribution (no-op without a profiler)
         _profile.note_worker_phase(r, "assemble", worker_s)
@@ -404,6 +549,21 @@ def run_chaos(
         )
     xs, ys = CifarLoader(data_dir).minibatches(plan.batch, train=True)
 
+    # the data plane under test: each round's clean window is an npz
+    # chunk in a local (file://) store, read THROUGH the content-
+    # addressed chunk cache every round — the path the cache_corruption
+    # and cache_cold faults attack (both runs use it, so the loss
+    # comparison is like-for-like)
+    from sparknet_tpu.data import chunk_cache as _chunk_cache
+    from sparknet_tpu.data import object_store as _object_store
+
+    chunk_dir = os.path.join(workdir, "chunk_store")
+    write_round_chunks(plan, xs, ys, chunk_dir)
+    chunk_source = (
+        _object_store.LocalStore("file://" + chunk_dir),
+        _chunk_cache.ChunkCache(os.path.join(workdir, "chunk_cache")),
+    )
+
     netp = cfg.replace_data_layers(
         models.load_model("cifar10_quick"),
         [(plan.batch, 3, 32, 32), (plan.batch,)],
@@ -440,7 +600,10 @@ def run_chaos(
         "storage_injected": 0, "storage_survived": 0,
         "stalls_injected": 0, "stalls_survived": 0,
     }
-    feed = _Feed(base_plan, xs, ys, base_counters, events, mesh)
+    feed = _Feed(
+        base_plan, xs, ys, base_counters, events, mesh,
+        chunk_source=chunk_source,
+    )
     state = trainer.init_state(seed=plan.seed)
     losses = None
     for r in range(plan.rounds):
@@ -449,6 +612,9 @@ def run_chaos(
     feed.close()
     baseline_loss = final_round_loss(losses)
     note(f"baseline (no faults): final-round loss {baseline_loss:.4f}")
+    # the artifact's cache_stats describe the FAULTED run only — the
+    # shared cache also served the baseline leg, so record the offset
+    cache_stats_before = dict(chunk_source[1].stats)
 
     # ---------------- the faulted run
     counters = {
@@ -456,7 +622,10 @@ def run_chaos(
         "stalls_injected": 0, "stalls_survived": 0,
     }
     fault_state: Dict = {}
-    feed = _Feed(plan, xs, ys, counters, events, mesh, fault_state)
+    feed = _Feed(
+        plan, xs, ys, counters, events, mesh, fault_state,
+        chunk_source=chunk_source,
+    )
     prefix = os.path.join(workdir, "chaos_ckpt")
     state = trainer.init_state(seed=plan.seed)
     losses = None
@@ -631,7 +800,10 @@ def run_chaos(
                     preempted_at + 1 - start_round,
                 )
             )
-            feed = _Feed(plan, xs, ys, counters, events, mesh, fault_state)
+            feed = _Feed(
+                plan, xs, ys, counters, events, mesh, fault_state,
+                chunk_source=chunk_source,
+            )
             for r in range(start_round, plan.rounds):
                 run_round(feed, r)
             feed.close()
@@ -663,6 +835,10 @@ def run_chaos(
         "straggler_injection": (
             "straggler_injected", "straggler_survived",
         ),
+        "cache_corruption": (
+            "cache_corrupt_injected", "cache_corrupt_survived",
+        ),
+        "cache_cold": ("cache_cold_injected", "cache_cold_survived"),
     }
     faults = {
         kind: {
@@ -690,6 +866,14 @@ def run_chaos(
         "straggler_detected_worker": counters.get(
             "straggler_detected_worker"
         ),
+        "cache_corrupt_round": plan.cache_corrupt_round,
+        "cache_cold_round": plan.cache_cold_round,
+        # the faulted run's own cache traffic (baseline-leg reads on the
+        # shared cache subtracted out)
+        "cache_stats": {
+            k: v - cache_stats_before.get(k, 0)
+            for k, v in chunk_source[1].stats.items()
+        },
         "recovery_latency_s": (
             round(recovery_latency_s, 3)
             if recovery_latency_s is not None

@@ -21,6 +21,7 @@ mesh; the P2PSync role is AllReduceTrainer).  ``test`` scores real data:
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from typing import Dict, Optional
 
@@ -59,6 +60,39 @@ def _declared_feed_shapes(netp, phase):
             if shapes:
                 return [tuple(s) for s in shapes]
     return None
+
+
+def _stage_cached_dir(url: str, cache_dir, cache_bytes) -> str:
+    """Materialize an object-store root as a local directory view whose
+    files are chunk-cache entries (verified, refetch-on-corrupt): list
+    the store, pull every ``*.bin`` through the cache, symlink the
+    verified chunk paths under ``<cache>/views/<key>/`` — the CIFAR
+    loader reads ordinary local files, the network is touched once."""
+    import tempfile
+
+    from sparknet_tpu.data import chunk_cache, object_store
+
+    store = object_store.open_store(url)
+    cache = chunk_cache.ChunkCache(
+        cache_dir or tempfile.mkdtemp(prefix="sparknet_cache_"),
+        byte_budget=chunk_cache.parse_bytes(cache_bytes),
+    )
+    view = os.path.join(
+        cache.root, "views", chunk_cache.ChunkCache.key_for(store.url, "")
+    )
+    os.makedirs(view, exist_ok=True)
+    names = [n for n in store.list("") if n.endswith(".bin")]
+    if not names:
+        raise SystemExit(f"train: no *.bin objects under {url!r}")
+    for name in names:
+        path = cache.local_path(store, name)
+        link = os.path.join(view, name)
+        # object names may carry path separators (recursive listings)
+        os.makedirs(os.path.dirname(link) or view, exist_ok=True)
+        if os.path.islink(link) or os.path.exists(link):
+            os.unlink(link)
+        os.symlink(path, link)
+    return view
 
 
 def cmd_train(args) -> int:
@@ -197,7 +231,18 @@ def _cmd_train(args) -> int:
 
     sampler = None
     if args.data:
-        loader = CifarLoader(args.data)
+        from sparknet_tpu.data import object_store
+
+        data_dir = args.data
+        if object_store.is_object_store_url(args.data):
+            # stage the CIFAR binaries through the chunk cache: verified
+            # local files, CRC-checked on every read, refetched only
+            # when missing/evicted/corrupt — a re-run is I/O-free
+            data_dir = _stage_cached_dir(
+                args.data, args.cache_dir, args.cache_bytes
+            )
+            print(f"staged {args.data} -> {data_dir} (chunk cache)")
+        loader = CifarLoader(data_dir)
         x, y = loader.minibatches(
             solver.net.blob_shapes[solver.net.feed_blobs[0]][0]
         )
@@ -220,7 +265,34 @@ def _cmd_train(args) -> int:
     # restores assemble-then-put on this loop, identical numerics)
     from sparknet_tpu.data import RoundFeed
 
+    # --shuffle_epochs: deterministic epoch passes over the partition,
+    # re-permuting the minibatch ORDER each epoch (shuffle-by-assignment
+    # over indices — the table moves, the resident arrays do not).
+    # Keyed by the ABSOLUTE round (start iter // tau + r): a resumed
+    # run continues the same schedule mid-epoch.
+    epoch_draw = None
+    if sampler is not None and args.shuffle_epochs > 1:
+        from sparknet_tpu.data import shuffle as shuffle_mod
+
+        windows_per_epoch = max(1, sampler.total // args.tau)
+        base_round = it // args.tau
+        perm_memo = {}
+
+        def epoch_draw(r):
+            abs_r = base_round + r
+            e = abs_r // windows_per_epoch
+            if e not in perm_memo:
+                perm_memo.clear()  # one epoch's table at a time
+                perm_memo[e] = shuffle_mod.permutation(
+                    sampler.total, args.seed, e
+                )
+            pos = (abs_r % windows_per_epoch) * args.tau
+            idx = perm_memo[e][pos : pos + args.tau]
+            return {k: v[idx] for k, v in sampler.batches.items()}
+
     def assemble(r, out):
+        if epoch_draw is not None:
+            return epoch_draw(r)
         return (
             sampler.next_window()
             if sampler
@@ -820,7 +892,24 @@ def main(argv=None) -> int:
                    "under the solver's snapshot_prefix (corrupt ones "
                    "are quarantined and skipped)")
     p.add_argument("--weights", default=None)
-    p.add_argument("--data", default=None, help="CIFAR binary dir")
+    p.add_argument("--data", default=None,
+                   help="CIFAR binary dir, or a gs://|s3://|http(s)://|"
+                   "file:// url staged through the chunk cache")
+    p.add_argument("--cache_dir", default=None,
+                   help="chunk-cache root for an object-store --data "
+                   "(data/chunk_cache.py; default: a temp dir)")
+    p.add_argument("--cache_bytes", default="0",
+                   help="chunk-cache LRU byte budget, e.g. 512M / 8G "
+                   "(0 = unbounded)")
+    p.add_argument("--shuffle_epochs", type=int, default=0,
+                   help="with a value >= 2, draw training windows as "
+                   "deterministic epoch passes whose minibatch ORDER "
+                   "re-permutes each epoch (seeded shuffle-by-"
+                   "assignment, data/shuffle.py); resume-aware via the "
+                   "absolute iteration.  0/1 = the legacy random "
+                   "windows (matching the averaging apps' 0/1 = off). "
+                   "Unlike the apps, the value does not split the run: "
+                   "an epoch here is one data pass (total/tau windows)")
     p.add_argument("--tau", type=int, default=10)
     p.add_argument("--max_iter", type=int, default=0)
     p.add_argument("--seed", type=int, default=0)

@@ -118,6 +118,7 @@ def test_unknown_mode_rejected():
     assert "health" in out.stderr  # ... and the training-health mode
     assert "scaling" in out.stderr  # ... and the scaling/comm-A/B mode
     assert "profile" in out.stderr  # ... and the round-anatomy mode
+    assert "datacache" in out.stderr  # ... and the data-plane cache mode
     # env-var route rejects identically
     out = subprocess.run(
         [sys.executable, os.path.join(_REPO, "bench.py")],
@@ -430,7 +431,10 @@ def test_perf_gate_passes_over_committed_artifacts():
     assert rc == 0 and not fails, fails
     # every family with a committed artifact was actually gated
     gated = {r["family"] for r in rows}
-    for fam in ("PIPELINE", "OBS", "HEALTH", "CHAOS", "SERVE", "PROFILE"):
+    for fam in (
+        "PIPELINE", "OBS", "HEALTH", "CHAOS", "SERVE", "PROFILE",
+        "DATACACHE",
+    ):
         assert fam in gated, fam
 
 
@@ -500,16 +504,19 @@ _CHAOS_SCHEMA_KEYS = (
     "faults_survived", "faults", "recovery_latency_s", "resumed_from_iter",
     "quarantined", "final_loss", "baseline_final_loss", "loss_band",
     "loss_band_ok", "final_iter", "seed", "workers", "rounds", "tau",
+    "cache_stats",
 )
 
 
 def test_committed_chaos_artifact_schema():
-    """CHAOS_r07.json — the fault-tolerance committed artifact: every
+    """CHAOS_r12.json — the fault-tolerance committed artifact: every
     injected fault survived (the ISSUE 2 done-bar), every fault CLASS
-    fired, the run resumed from an OLDER verified snapshot after the
-    newest was corrupted+quarantined, and the final loss sat inside the
-    no-fault run's band."""
-    with open(os.path.join(_REPO, "CHAOS_r07.json")) as f:
+    fired — including the round-12 data-plane faults (cache entry
+    corrupted -> quarantined + refetched; cache wiped cold ->
+    refilled) — the run resumed from an OLDER verified snapshot after
+    the newest was corrupted+quarantined, and the final loss sat inside
+    the no-fault run's band."""
+    with open(os.path.join(_REPO, "CHAOS_r12.json")) as f:
         d = json.load(f)
     for key in _CHAOS_SCHEMA_KEYS:
         assert key in d, key
@@ -520,7 +527,8 @@ def test_committed_chaos_artifact_schema():
     assert d["vs_baseline"] == 1.0
     for kind in (
         "storage", "stall", "preemption", "snapshot_corruption",
-        "dead_worker",
+        "dead_worker", "nan_injection", "straggler_injection",
+        "cache_corruption", "cache_cold",
     ):
         v = d["faults"][kind]
         assert v["injected"] >= 1, kind
@@ -532,6 +540,75 @@ def test_committed_chaos_artifact_schema():
     )
     assert d["loss_band_ok"] is True
     assert abs(d["final_loss"] - d["baseline_final_loss"]) <= d["loss_band"]
+    # the chunk cache really sat in the data path: the corrupt entry
+    # was quarantined and the cold wipe forced refetches
+    assert d["cache_stats"]["quarantined"] >= 1
+    assert d["cache_stats"]["hits"] > 0 and d["cache_stats"]["misses"] > 0
+
+
+@pytest.mark.slow
+def test_datacache_mode_smoke():
+    """bench.py --mode=datacache end to end in a subprocess: one JSON
+    line, zero warm-epoch fetches, byte identity pinned."""
+    rec = _run_bench({
+        "BENCH_MODE": "datacache", "BENCH_SHARDS": "4",
+        "BENCH_IMAGES": "4", "BENCH_FETCH_DELAY_MS": "10",
+    })
+    assert rec["metric"] == "datacache_warm_epoch_speedup"
+    assert rec["value"] > 1.0
+    assert rec["warm_epoch_fetches"] == 0
+    assert rec["cold_epoch_fetches"] == rec["shards"] == 4
+    assert rec["nocache_epoch2_fetches"] == rec["nocache_epoch1_fetches"]
+    assert rec["bytes_identical"] is True
+    assert rec["minibatches_identical"] is True
+
+
+_DATACACHE_SCHEMA_KEYS = (
+    "metric", "value", "unit", "vs_baseline", "platform", "shards",
+    "images_per_shard", "workers", "fetch_delay_ms",
+    "payload_bytes_per_epoch", "nocache_epoch1_fetches",
+    "nocache_epoch2_fetches", "nocache_epoch2_wall_ms",
+    "cold_epoch_fetches", "cold_epoch_wall_ms", "warm_epoch_fetches",
+    "warm_epoch_wall_ms", "assignment_moved_shards", "bytes_identical",
+    "minibatches_identical", "cache_stats", "note",
+)
+
+
+def test_committed_datacache_artifact_schema():
+    """DATACACHE_r12.json — the I/O-flat data-plane committed artifact
+    (ISSUE 8 done-bar): the warm (cache-filled, SHUFFLED-assignment)
+    epoch made zero network fetches where the no-cache leg re-fetched
+    everything, ran strictly faster than the cold epoch, and served
+    bytes identical to the streamed path."""
+    with open(os.path.join(_REPO, "DATACACHE_r12.json")) as f:
+        d = json.load(f)
+    for key in _DATACACHE_SCHEMA_KEYS:
+        assert key in d, key
+    assert d["metric"] == "datacache_warm_epoch_speedup"
+    # vs_baseline derives from the ROUNDED value (the PR-7 emitter
+    # convention) — here value IS the rounded ratio and the done-bar
+    assert d["vs_baseline"] == d["value"] > 1.0
+    # I/O-flat: zero warm fetches; I/O-linear without the cache
+    assert d["warm_epoch_fetches"] == 0
+    assert d["cold_epoch_fetches"] == d["shards"] > 0
+    assert d["nocache_epoch2_fetches"] == d["nocache_epoch1_fetches"] > 0
+    # warm wall strictly below cold (the ratio is the headline)
+    assert d["warm_epoch_wall_ms"] < d["cold_epoch_wall_ms"]
+    # headline ratio consistent with the recorded walls (both rounded)
+    assert d["value"] == pytest.approx(
+        d["cold_epoch_wall_ms"] / d["warm_epoch_wall_ms"], rel=0.01
+    )
+    # the reshuffle moved ownership (the table), not bytes
+    assert 0 < d["assignment_moved_shards"] <= d["shards"]
+    # bit-identity contract: cached bytes == streamed bytes
+    assert d["bytes_identical"] is True
+    assert d["minibatches_identical"] is True
+    # the cache accounting agrees: one miss per shard, then hits
+    assert d["cache_stats"]["misses"] == d["shards"]
+    assert d["cache_stats"]["hits"] >= d["shards"]
+    assert d["cache_stats"]["quarantined"] == 0
+    # the modeled latency is disclosed
+    assert "latency" in d["note"] and d["fetch_delay_ms"] > 0
 
 
 _SERVE_SCHEMA_KEYS = (

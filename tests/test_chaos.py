@@ -5,7 +5,7 @@ preemption with simulated process death, newest-snapshot corruption
 quarantined + fallback restore, and a dead dp worker masked out of the
 average — and requires every injected fault survived plus a final loss
 inside the no-fault baseline's band (the acceptance bar for
-``CHAOS_r07.json``)."""
+``CHAOS_r12.json``)."""
 
 import dataclasses
 import os
@@ -40,6 +40,13 @@ def test_default_plan_covers_every_fault_class():
     # the preemption must happen after at least one periodic snapshot,
     # or there is nothing valid to fall back to after the corruption
     assert plan.preempt_round + 1 > plan.snapshot_every
+    # the cache faults: corruption fires BEFORE the preemption (the
+    # replay must not re-fire it), the cold wipe AFTER it (the resumed
+    # process is the one that pays the cold refill — the realistic case)
+    assert plan.cache_corrupt_round is not None
+    assert plan.cache_corrupt_round < plan.preempt_round
+    assert plan.cache_cold_round is not None
+    assert plan.cache_cold_round > plan.preempt_round
 
 
 def test_no_fault_view_strips_all_faults():
@@ -48,6 +55,8 @@ def test_no_fault_view_strips_all_faults():
     assert base.preempt_round is None and not base.corrupt_newest
     assert base.dead_worker is None and base.nan_round is None
     assert base.straggler_round is None
+    assert base.cache_corrupt_round is None
+    assert base.cache_cold_round is None
     # run geometry unchanged: the baseline is comparable
     plan = chaos.FaultPlan.default()
     for f in ("seed", "workers", "rounds", "tau", "batch"):
@@ -179,6 +188,20 @@ def test_chaos_smoke_default_plan(tmp_path):
     # (the profiler's per-worker verdict, ISSUE 7 acceptance)
     assert rep["faults"]["straggler_injection"]["survived"] == 1
     assert rep["straggler_detected_worker"] == rep["straggler_worker"]
+
+    # the cache faults (ISSUE 8 acceptance): the corrupt entry was
+    # quarantined (*.corrupt in the cache) and refetched byte-identical;
+    # the cold wipe refilled from the backing store
+    assert rep["faults"]["cache_corruption"]["survived"] == 1
+    assert rep["faults"]["cache_cold"]["survived"] == 1
+    assert rep["cache_stats"]["quarantined"] >= 1
+    assert rep["cache_stats"]["misses"] >= 1 and (
+        rep["cache_stats"]["hits"] >= 1
+    )
+    cache_dir = os.path.join(str(tmp_path), "chunk_cache", "objects")
+    assert any(
+        f.endswith(".corrupt") for f in os.listdir(cache_dir)
+    ), "quarantined cache entry must stay on disk for forensics"
 
     # quarantined files really are on disk, out of the resume scan
     corrupt = [f for f in os.listdir(str(tmp_path)) if f.endswith(".corrupt")]

@@ -1,0 +1,338 @@
+"""Content-addressed chunk cache (``data/chunk_cache.py``): fetch-
+through semantics, the CRC-manifest/quarantine/refetch contract, LRU
+eviction at a byte budget, staleness invalidation, atomic-publish crash
+semantics, the I/O-flat multi-epoch loader path, and the obs counters —
+the data-plane half of ISSUE 8's acceptance."""
+
+import http.server
+import json
+import os
+import threading
+import urllib.parse
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.data import chunk_cache, object_store, shuffle
+from sparknet_tpu.data.chunk_cache import CachingStore, ChunkCache
+from sparknet_tpu.data.imagenet import (
+    ImageNetLoader,
+    ScaleAndConvert,
+    write_synthetic_imagenet,
+)
+
+
+@pytest.fixture(scope="module")
+def shard_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("cache_objstore"))
+    write_synthetic_imagenet(
+        d, num_shards=4, images_per_shard=6, classes=3, seed=5
+    )
+    return d
+
+
+@pytest.fixture()
+def counting_http(shard_dir):
+    """A local HTTP store whose per-object GET counts are visible —
+    the fetch-counting transport every I/O-flat assertion uses."""
+    fetches = {}
+
+    class Handler(http.server.SimpleHTTPRequestHandler):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, directory=shard_dir, **kw)
+
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            name = urllib.parse.unquote(self.path.lstrip("/"))
+            fetches[name] = fetches.get(name, 0) + 1
+            return super().do_GET()
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        yield f"http://127.0.0.1:{srv.server_address[1]}", fetches
+    finally:
+        srv.shutdown()
+
+
+def _tar_fetches(fetches):
+    return sum(n for name, n in fetches.items() if name.endswith(".tar"))
+
+
+def test_fetch_through_miss_then_hit(tmp_path, counting_http):
+    root, fetches = counting_http
+    store = object_store.open_store(root)
+    cache = ChunkCache(str(tmp_path / "cache"))
+    name = "train.00000.tar"
+    a = cache.get(store, name)
+    assert _tar_fetches(fetches) == 1 and cache.stats["misses"] == 1
+    b = cache.get(store, name)
+    assert _tar_fetches(fetches) == 1  # served locally, no network
+    assert cache.stats["hits"] == 1
+    assert a == b == store.read(name)  # byte identity, both paths
+    # the entry's CRC manifest is on disk, checkpoint-style
+    key = ChunkCache.key_for(store.url, name)
+    with open(os.path.join(cache.root, "objects", key + ".meta.json")) as f:
+        meta = json.load(f)
+    assert meta["size"] == len(a)
+    import zlib
+
+    assert meta["crc32"] == zlib.crc32(a) & 0xFFFFFFFF
+    assert meta["name"] == name and meta["url"] == store.url
+
+
+def test_corrupt_entry_quarantined_and_refetched(tmp_path, counting_http):
+    """The cache_corruption contract: a byte-flipped published entry
+    (size unchanged — only the CRC can tell) is quarantined to
+    ``*.corrupt`` and transparently refetched byte-identical."""
+    root, fetches = counting_http
+    store = object_store.open_store(root)
+    cache = ChunkCache(str(tmp_path / "cache"))
+    name = "train.00001.tar"
+    clean = cache.get(store, name)
+    entry = cache.entry_path(store.url, name)
+    with open(entry, "r+b") as f:
+        f.seek(len(clean) // 2)
+        orig = f.read(8)
+        f.seek(len(clean) // 2)
+        f.write(bytes(b ^ 0xFF for b in orig))
+    n_before = _tar_fetches(fetches)
+    again = cache.get(store, name)
+    assert again == clean  # the caller never sees the corruption
+    assert _tar_fetches(fetches) == n_before + 1  # one refetch
+    assert cache.stats["quarantined"] == 1
+    corrupt = [
+        f for f in os.listdir(os.path.join(cache.root, "objects"))
+        if f.endswith(".corrupt")
+    ]
+    assert corrupt, "quarantined evidence must stay on disk"
+    # and the refreshed entry verifies again
+    assert cache.get(store, name) == clean
+    assert cache.stats["quarantined"] == 1  # no second quarantine
+
+
+def test_truncated_entry_detected_by_size(tmp_path, counting_http):
+    root, fetches = counting_http
+    store = object_store.open_store(root)
+    cache = ChunkCache(str(tmp_path / "cache"))
+    name = "train.00002.tar"
+    clean = cache.get(store, name)
+    entry = cache.entry_path(store.url, name)
+    with open(entry, "r+b") as f:
+        f.truncate(len(clean) // 2)
+    assert cache.get(store, name) == clean
+    assert cache.stats["quarantined"] == 1
+
+
+def test_manifestless_chunk_is_a_miss_not_corruption(
+    tmp_path, counting_http
+):
+    """Atomic-publish crash semantics: a kill between the chunk write
+    and the manifest leaves a manifest-less chunk — the next read
+    treats it as a plain miss (refetch + republish), never serves
+    unverifiable bytes, and does not count a quarantine."""
+    root, fetches = counting_http
+    store = object_store.open_store(root)
+    cache = ChunkCache(str(tmp_path / "cache"))
+    name = "train.00003.tar"
+    clean = cache.get(store, name)
+    key = ChunkCache.key_for(store.url, name)
+    os.unlink(os.path.join(cache.root, "objects", key + ".meta.json"))
+    assert cache.get(store, name) == clean
+    assert cache.stats["misses"] == 2  # refetched
+    assert cache.stats["quarantined"] == 0
+    # fully republished: the manifest is back
+    assert os.path.exists(
+        os.path.join(cache.root, "objects", key + ".meta.json")
+    )
+
+
+def test_lru_eviction_at_byte_budget(tmp_path, counting_http):
+    root, fetches = counting_http
+    store = object_store.open_store(root)
+    shards = [n for n in store.list("") if n.endswith(".tar")]
+    sizes = {n: len(store.read(n)) for n in shards}
+    # budget fits two largest chunks + slack, not three
+    budget = sizes[shards[0]] + sizes[shards[1]] + 16
+    cache = ChunkCache(str(tmp_path / "cache"), byte_budget=budget)
+    cache.get(store, shards[0])
+    import time as _time
+
+    _time.sleep(0.02)  # mtime resolution: make LRU order unambiguous
+    cache.get(store, shards[1])
+    _time.sleep(0.02)
+    cache.get(store, shards[2])
+    assert cache.stats["evictions"] >= 1
+    assert cache.total_bytes() <= budget
+    # the OLDEST entry went; the newest stayed
+    assert cache.entry_path(store.url, shards[0]) is None
+    assert cache.entry_path(store.url, shards[2]) is not None
+    # re-reading the evicted shard is a clean miss
+    n_before = _tar_fetches(fetches)
+    cache.get(store, shards[0])
+    assert _tar_fetches(fetches) == n_before + 1
+
+
+def test_local_path_pins_entry_against_eviction(tmp_path, counting_http):
+    """A path handed out by local_path() is held by a live consumer (DB
+    reader, staged view symlink) — the LRU budget sweep must evict
+    around it, never unlink it."""
+    root, fetches = counting_http
+    store = object_store.open_store(root)
+    shards = [n for n in store.list("") if n.endswith(".tar")]
+    sizes = {n: len(store.read(n)) for n in shards}
+    # budget fits barely more than one chunk: every later publish
+    # forces an eviction sweep
+    budget = sizes[shards[0]] + 16
+    cache = ChunkCache(str(tmp_path / "cache"), byte_budget=budget)
+    pinned_path = cache.local_path(store, shards[0])
+    import time as _time
+
+    for s in shards[1:]:
+        _time.sleep(0.02)
+        cache.get(store, s)
+    # the pinned entry (the LRU-oldest!) is still on disk and verifies
+    assert os.path.exists(pinned_path)
+    assert cache.get(store, shards[0]) == open(pinned_path, "rb").read()
+    assert cache.stats["evictions"] >= 1  # others did evict
+
+
+def test_caching_store_open_streams_without_pinning(
+    tmp_path, counting_http
+):
+    """CachingStore.open() is the tar-streaming hot path: it must serve
+    from memory, NOT pin the entry like local_path does — otherwise a
+    whole-dataset stream pins everything and the --cache_bytes budget
+    is inert."""
+    import time as _time
+
+    root, fetches = counting_http
+    inner = object_store.open_store(root)
+    shards = [n for n in inner.list("") if n.endswith(".tar")]
+    sizes = {n: len(inner.read(n)) for n in shards}
+    budget = sizes[shards[0]] + 16
+    cache = ChunkCache(str(tmp_path / "cache"), byte_budget=budget)
+    store = CachingStore(inner, cache)
+    for s in shards:
+        with store.open(s) as f:
+            assert f.read() == inner.read(s)
+        _time.sleep(0.02)
+    # the budget stayed effective across the full stream
+    assert cache.stats["evictions"] >= 1
+    assert cache.total_bytes() <= budget
+
+
+def test_stale_etag_and_size_invalidate(tmp_path):
+    class VersionedStore:
+        url = "fake://versioned"
+
+        def __init__(self):
+            self.version = "v1"
+            self.reads = 0
+
+        def read_with_info(self, name):
+            self.reads += 1
+            return f"payload-{self.version}".encode(), self.version
+
+    st = VersionedStore()
+    cache = ChunkCache(str(tmp_path / "cache"))
+    assert cache.get(st, "obj") == b"payload-v1"
+    # matching etag: still a hit
+    assert cache.get(st, "obj", etag="v1") == b"payload-v1"
+    assert st.reads == 1
+    # upstream changed: a mismatched expected etag forces a refetch
+    st.version = "v2"
+    assert cache.get(st, "obj", etag="v2") == b"payload-v2"
+    assert st.reads == 2
+    # size mismatch invalidates the same way
+    st.version = "v3-longer"
+    assert cache.get(st, "obj", size=len(b"payload-v3-longer")) == (
+        b"payload-v3-longer"
+    )
+    assert st.reads == 3
+
+
+def test_caching_store_open_read_and_local_path(tmp_path, counting_http):
+    root, fetches = counting_http
+    inner = object_store.open_store(root)
+    cache = ChunkCache(str(tmp_path / "cache"))
+    store = CachingStore(inner, cache)
+    assert store.list("train.") == inner.list("train.")
+    name = "train.txt"
+    direct_bytes = inner.read(name)  # uncached reference fetch
+    n0 = fetches.get(name, 0)
+    with store.open(name) as f:
+        via_open = f.read()
+    assert via_open == direct_bytes == store.read(name)
+    p = store.local_path(name)
+    assert os.path.exists(p) and open(p, "rb").read() == via_open
+    # one network fetch total across open/read/local_path
+    assert fetches.get(name, 0) == n0 + 1
+
+
+def test_imagenet_loader_multi_epoch_io_flat(tmp_path, counting_http):
+    """The tentpole wire-through: ImageNetLoader fronted by the cache,
+    epoch 2 under a SHUFFLED assignment streams zero shard bytes off
+    the network, and the decoded minibatches are byte-identical to the
+    direct-streaming path."""
+    root, fetches = counting_http
+    loader = ImageNetLoader(root, cache_dir=str(tmp_path / "cache"))
+    direct = ImageNetLoader(root)
+    conv = ScaleAndConvert(batch_size=3, height=24, width=24)
+
+    def consume(ldr, epoch):
+        parts = ldr.partitions(
+            "train.", "train.txt", num_parts=2,
+            epoch=epoch, shuffle_seed=9,
+        )
+        return [list(conv.make_minibatches(p)) for p in parts]
+
+    e0 = consume(loader, 0)
+    cold = _tar_fetches(fetches)
+    assert cold == 4  # every shard fetched once
+    e1 = consume(loader, 1)
+    assert _tar_fetches(fetches) == cold, "warm epoch streamed bytes"
+    # the reshuffle really moved ownership between epochs
+    shards = loader.list_shards("train.")
+    moved = shuffle.ShuffleByAssignment(shards, 2, seed=9).moved(0, 1)
+    assert moved > 0
+    # byte identity vs the uncached streaming path, same assignment
+    d0 = consume(direct, 0)
+    for cached_part, direct_part in zip(e0, d0):
+        assert len(cached_part) == len(direct_part)
+        for (ci, cl), (di, dl) in zip(cached_part, direct_part):
+            assert np.array_equal(ci, di) and np.array_equal(cl, dl)
+    assert e1, "shuffled epoch produced minibatches"
+
+
+def test_cache_obs_counters(tmp_path, counting_http):
+    from sparknet_tpu import obs
+
+    root, fetches = counting_http
+    store = object_store.open_store(root)
+    obs._reset_training_metrics_for_tests()
+    try:
+        tm = obs.enable_training_metrics()
+        h0, m0 = tm.cache_hits.value, tm.cache_misses.value
+        cache = ChunkCache(str(tmp_path / "cache"))
+        cache.get(store, "train.00000.tar")
+        cache.get(store, "train.00000.tar")
+        assert tm.cache_misses.value == m0 + 1
+        assert tm.cache_hits.value == h0 + 1
+        text = tm.registry.render()
+        assert "sparknet_cache_hits_total" in text
+        assert 'sparknet_cache_bytes_total{src="miss"}' in text
+    finally:
+        obs._reset_training_metrics_for_tests()
+
+
+def test_parse_bytes_units():
+    pb = chunk_cache.parse_bytes
+    assert pb(None) == 0 and pb("") == 0 and pb(0) == 0
+    assert pb("1024") == 1024 and pb(2048) == 2048
+    assert pb("512k") == 512 << 10
+    assert pb("1.5M") == int(1.5 * (1 << 20))
+    assert pb("8G") == 8 << 30
+    assert pb("2GiB") == 2 << 30

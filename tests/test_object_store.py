@@ -72,6 +72,252 @@ def test_http_store_index_txt_overrides_autoindex(shard_dir, http_root):
         os.remove(os.path.join(shard_dir, "index.txt"))
 
 
+def _serve(handler_cls):
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler_cls)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def test_s3_list_unescapes_xml_keys_and_paginates():
+    """S3 satellites: ListObjectsV2 bodies are XML — keys containing
+    ``&``/``<`` arrive entity-escaped (``&amp;``/``&lt;``) and big
+    listings paginate via NextContinuationToken.  Names must unescape
+    (else the later GET 404s), strip the root prefix, and accumulate
+    across 2+ pages in globally sorted order."""
+    from html import escape
+
+    objects = {
+        "pre/a&b shard.tar": b"AB",
+        "pre/c<d.tar": b"CD",
+        "pre/plain.tar": b"PL",
+        "pre/z&last.tar": b"ZL",
+    }
+    keys = sorted(objects)
+    tokens_seen = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            parsed = urllib.parse.urlparse(self.path)
+            q = urllib.parse.parse_qs(parsed.query)
+            if "list-type" in q:
+                token = q.get("continuation-token", [""])[0]
+                tokens_seen.append(token)
+                start = 2 if token else 0  # 2 keys per page
+                page = keys[start : start + 2]
+                nct = (
+                    "<NextContinuationToken>tok&amp;2</"
+                    "NextContinuationToken>"
+                    if start + 2 < len(keys)
+                    else ""
+                )
+                body = (
+                    "<?xml version='1.0'?><ListBucketResult>"
+                    + "".join(
+                        f"<Key>{escape(k)}</Key>" for k in page
+                    )
+                    + nct
+                    + "</ListBucketResult>"
+                ).encode()
+            else:
+                key = urllib.parse.unquote(parsed.path.lstrip("/"))
+                if key not in objects:
+                    self.send_error(404)
+                    return
+                body = objects[key]
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv, root = _serve(Handler)
+    try:
+        store = object_store.S3Store("s3://bucket/pre", endpoint=root)
+        names = store.list("")
+        # unescaped, prefix-stripped, sorted — across both pages
+        assert names == sorted(
+            ["a&b shard.tar", "c<d.tar", "plain.tar", "z&last.tar"]
+        )
+        # the continuation token itself was unescaped before reuse
+        assert tokens_seen == ["", "tok&2"]
+        # and the unescaped name actually FETCHES (the regression: an
+        # escaped name 404s)
+        assert store.read("a&b shard.tar") == b"AB"
+    finally:
+        srv.shutdown()
+
+
+def test_gcs_list_pagination_two_pages(shard_dir):
+    """GCS satellite: the ``pageToken`` loop (object_store.py) had no
+    multi-page coverage — force 2 pages and assert order + root-prefix
+    stripping."""
+    names_all = sorted(os.listdir(shard_dir))
+    pages_seen = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            parsed = urllib.parse.urlparse(self.path)
+            assert parsed.path == "/storage/v1/b/mybucket/o"
+            q = urllib.parse.parse_qs(parsed.query)
+            token = q.get("pageToken", [""])[0]
+            pages_seen.append(token)
+            start = int(token) if token else 0
+            page = names_all[start : start + 2]
+            body = {
+                "items": [{"name": "imagenet/" + n} for n in page]
+            }
+            if start + 2 < len(names_all):
+                body["nextPageToken"] = str(start + 2)
+            raw = json.dumps(body).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+
+    srv, root = _serve(Handler)
+    try:
+        store = object_store.GCSStore("gs://mybucket/imagenet", endpoint=root)
+        assert store.list("") == names_all  # every page, prefix stripped
+        assert len(pages_seen) >= 2 and pages_seen[0] == ""
+        assert pages_seen[1:] == ["2", "4"][: len(pages_seen) - 1]
+    finally:
+        srv.shutdown()
+
+
+def test_read_refetches_after_midstream_truncation():
+    """Mid-stream satellite: a 200 whose body dies halfway (connection
+    reset / short body after Content-Length) must re-fetch the whole
+    object under the retry budget instead of propagating — ``open()``
+    alone retrying was not enough."""
+    payload = bytes(range(256)) * 64
+    attempts = {"n": 0}
+
+    class Flaky(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            attempts["n"] += 1
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(payload)))
+            self.send_header("ETag", '"v1-abc"')
+            self.end_headers()
+            if attempts["n"] == 1:
+                # half the body, then drop the connection: the client's
+                # read() sees IncompleteRead/ConnectionReset AFTER a
+                # successful open
+                self.wfile.write(payload[: len(payload) // 2])
+                self.wfile.flush()
+                self.connection.close()
+            else:
+                self.wfile.write(payload)
+
+    srv, root = _serve(Flaky)
+    try:
+        store = object_store.HTTPStore(root)
+        data, etag = store.read_with_info("blob.bin")
+        assert data == payload
+        assert attempts["n"] == 2  # one failed stream + one clean refetch
+        assert etag == "v1-abc"  # fetch-time ETag rides along, unquoted
+    finally:
+        srv.shutdown()
+
+
+def test_base_read_retries_midstream_reset_via_fake_store():
+    """The chaos-hook-style unit variant: any ObjectStore whose open()
+    succeeds but whose stream dies mid-read re-fetches through the SAME
+    retry classification; non-retryable errors still fail fast."""
+    import io as _io
+
+    class FlakyStream:
+        def __init__(self):
+            self.closed = False
+
+        def read(self):
+            raise ConnectionResetError("chaos: reset mid-body")
+
+        def close(self):
+            self.closed = True
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            self.close()
+
+    class FlakyStore(object_store.ObjectStore):
+        url = "fake://flaky"
+
+        def __init__(self):
+            self.opens = 0
+
+        def open(self, name):
+            self.opens += 1
+            if self.opens == 1:
+                return FlakyStream()
+            return _io.BytesIO(b"the payload")
+
+    st = FlakyStore()
+    assert st.read("x") == b"the payload"
+    assert st.opens == 2
+
+    class NotFoundStore(object_store.ObjectStore):
+        url = "fake://404"
+
+        def __init__(self):
+            self.opens = 0
+
+        def open(self, name):
+            self.opens += 1
+            raise FileNotFoundError(name)  # permanent: no retry
+
+    nf = NotFoundStore()
+    with pytest.raises(FileNotFoundError):
+        nf.read("x")
+    assert nf.opens == 1
+
+    # an open() that exhausted ITS retry budget propagates immediately —
+    # the mid-stream loop must not multiply the two budgets by
+    # re-entering open()'s backoff schedule
+    from sparknet_tpu.utils.retry import RetryBudgetExceeded
+
+    class ExhaustedStore(object_store.ObjectStore):
+        url = "fake://exhausted"
+
+        def __init__(self):
+            self.opens = 0
+
+        def open(self, name):
+            self.opens += 1
+            raise RetryBudgetExceeded("gave up inside open()")
+
+    ex = ExhaustedStore()
+    with pytest.raises(RetryBudgetExceeded):
+        ex.read("x")
+    assert ex.opens == 1
+
+
+def test_local_store_file_url_roundtrip(shard_dir):
+    """file:// roots ride the same ObjectStore surface (the chaos
+    harness's chunk store; mounted datasets)."""
+    assert object_store.is_object_store_url("file:///tmp/x")
+    store = object_store.open_store("file://" + shard_dir)
+    names = store.list("train.")
+    assert [n for n in names if n.endswith(".tar")] == [
+        "train.00000.tar", "train.00001.tar",
+    ]
+    with open(os.path.join(shard_dir, "train.txt"), "rb") as f:
+        assert store.read("train.txt") == f.read()
+    # ImageNetLoader routes file:// through the store path too
+    loader = ImageNetLoader("file://" + shard_dir)
+    assert len(loader.list_shards("train.")) == 2
+
+
 def test_gcs_store_against_emulator(shard_dir):
     """GCSStore's JSON-list + alt=media fetch, against a minimal local
     emulation of the two endpoints."""

@@ -115,6 +115,41 @@ def test_check_fails_on_collapsed_live_hidden_fraction(tmp_path):
     assert any(r["family"] == "PROFILE x PIPELINE" for r in bad)
 
 
+GOOD_DATACACHE = {
+    "value": 20.0, "warm_epoch_fetches": 0, "cold_epoch_fetches": 6,
+    "nocache_epoch2_fetches": 6, "bytes_identical": True,
+    "minibatches_identical": True,
+}
+
+
+def test_datacache_family_rules(tmp_path):
+    """The DATACACHE family (ISSUE 8): warm-epoch network fetches must
+    be EXACTLY zero and byte identity must hold — a single warm fetch
+    or a bytes mismatch fails --check."""
+    g = _gate()
+    _write(tmp_path, "DATACACHE_r12.json", GOOD_DATACACHE)
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 0, rows
+    _write(
+        tmp_path, "DATACACHE_r13.json",
+        dict(GOOD_DATACACHE, warm_epoch_fetches=1),
+    )
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 1
+    assert any(
+        "warm_epoch_fetches" in r["detail"] for r in rows if not r["ok"]
+    )
+    _write(
+        tmp_path, "DATACACHE_r13.json",
+        dict(GOOD_DATACACHE, bytes_identical=False),
+    )
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 1
+    assert any(
+        "bytes_identical" in r["detail"] for r in rows if not r["ok"]
+    )
+
+
 def test_missing_key_is_a_failure_not_a_pass(tmp_path):
     g = _gate()
     _write(tmp_path, "OBS_r09.json", {"overhead_traced_pct": 0.5})

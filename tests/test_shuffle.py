@@ -1,0 +1,102 @@
+"""Cross-epoch shuffle-by-assignment (``data/shuffle.py``): determinism
+(pure in seed+epoch — the resume contract), coverage (every item owned
+exactly once per epoch), balance, cross-epoch movement, and the
+loader/app wire-through semantics."""
+
+import pytest
+
+from sparknet_tpu.data import shuffle
+from sparknet_tpu.data.shuffle import ShuffleByAssignment, assign, permutation
+
+
+def test_permutation_pure_in_seed_and_epoch():
+    a = permutation(100, seed=3, epoch=7)
+    assert a == permutation(100, seed=3, epoch=7)  # resume-aware
+    assert sorted(a) == list(range(100))
+    assert a != permutation(100, seed=3, epoch=8)  # epochs re-deal
+    assert a != permutation(100, seed=4, epoch=7)  # seeds decorrelate
+    # nearby (seed, epoch) pairs don't alias (the naive seed+epoch trap)
+    assert permutation(100, seed=0, epoch=1) != permutation(
+        100, seed=1, epoch=0
+    )
+
+
+def test_assign_covers_every_item_exactly_once():
+    items = [f"shard.{i:04d}" for i in range(13)]
+    for epoch in range(4):
+        parts = assign(items, 4, seed=11, epoch=epoch)
+        flat = [s for p in parts for s in p]
+        assert sorted(flat) == sorted(items)  # no loss, no duplication
+        sizes = [len(p) for p in parts]
+        assert max(sizes) - min(sizes) <= 1  # round-robin balance
+
+
+def test_assign_matches_legacy_split_shape():
+    """The legacy deal is ``shards[w::n]``; the shuffled deal must keep
+    the same per-worker sizes so tau-feasibility doesn't change shape
+    between epochs."""
+    items = list(range(10))
+    legacy = [items[w::3] for w in range(3)]
+    for epoch in range(3):
+        parts = assign(items, 3, seed=0, epoch=epoch)
+        assert [len(p) for p in parts] == [len(p) for p in legacy]
+
+
+def test_service_table_and_moved():
+    svc = ShuffleByAssignment([f"s{i}" for i in range(12)], 4, seed=2)
+    t0, t1 = svc.table(0), svc.table(1)
+    assert set(t0) == set(t1) == {f"s{i}" for i in range(12)}
+    assert set(t0.values()) == set(range(4))
+    moved = svc.moved(0, 1)
+    # a real reshuffle moves ownership (statistically ~(1-1/W) of
+    # items; require at least one and allow up to all)
+    assert 0 < moved <= 12
+    assert svc.moved(0, 0) == 0  # same epoch: nothing moves
+    assert moved == sum(
+        1 for k in t0 if t0[k] != t1[k]
+    )
+
+
+def test_service_rejects_degenerate_inputs():
+    with pytest.raises(ValueError):
+        ShuffleByAssignment([], 2)
+    with pytest.raises(ValueError):
+        ShuffleByAssignment(["a"], 0)
+    with pytest.raises(ValueError):
+        assign(["a"], 0)
+
+
+def test_loader_partitions_epoch_reassignment(tmp_path):
+    """ImageNetLoader.partitions(epoch=...) routes ownership through
+    the service: same items, re-dealt per epoch, default path
+    unchanged."""
+    from sparknet_tpu.data.imagenet import (
+        ImageNetLoader,
+        write_synthetic_imagenet,
+    )
+
+    root = str(tmp_path / "shards")
+    write_synthetic_imagenet(
+        root, num_shards=4, images_per_shard=4, classes=2, seed=1
+    )
+    loader = ImageNetLoader(root)
+    shards = loader.list_shards("train.")
+
+    def names_per_worker(epoch):
+        parts = loader.partitions(
+            "train.", "train.txt", num_parts=2,
+            epoch=epoch, shuffle_seed=6,
+        )
+        # count items per partition — identity of shards is checked
+        # through the assign() call below (iterators hide shard names)
+        return [sum(1 for _ in p) for p in parts]
+
+    # every epoch still covers all images exactly once
+    assert sum(names_per_worker(0)) == sum(names_per_worker(1)) == 16
+    # the epoch tables really differ (the reshuffle happened)
+    a0 = shuffle.assign(shards, 2, seed=6, epoch=0)
+    a1 = shuffle.assign(shards, 2, seed=6, epoch=1)
+    assert a0 != a1
+    # legacy default (epoch=None) is the round-robin split, untouched
+    legacy = loader.partitions("train.", "train.txt", num_parts=2)
+    assert sum(sum(1 for _ in p) for p in legacy) == 16

@@ -130,6 +130,18 @@ RULES: Dict[str, List[Rule]] = {
         Rule("hidden_frac_h2d_p50", ">", 0.0),
         Rule("flops_cross_check_ratio", ">", 0.0),
     ],
+    "DATACACHE": [
+        # the I/O-flat contract: a warm (cache-filled, shuffled-
+        # assignment) epoch makes ZERO network fetches and is strictly
+        # faster than the cold epoch, with cached bytes byte-identical
+        # to streamed bytes
+        Rule("value", ">", 1.0),
+        Rule("warm_epoch_fetches", "==", 0),
+        Rule("cold_epoch_fetches", ">", 0),
+        Rule("nocache_epoch2_fetches", ">", 0),
+        Rule("bytes_identical", "is", True),
+        Rule("minibatches_identical", "is", True),
+    ],
 }
 
 
