@@ -1,0 +1,255 @@
+"""Donation discipline: a name must not be read again after being
+passed in a donated position of a ``jax.jit(..., donate_argnums=...)``
+callable — XLA deletes (or reuses) the donated buffer, and the next
+touch raises ``Array has been deleted`` on real chips (the CPU backend
+often silently skips donation, so only the linter and a real-TPU run
+catch it).
+
+Two reuse shapes are caught, intraprocedurally:
+
+1. straight-line: ``out = f(state, batch); use(batch)``;
+2. loop-carried — the classic one: ``for r in ...: state, _ = f(state,
+   batch)`` where ``batch`` is built once OUTSIDE the loop, so
+   iteration 2 feeds a donated (deleted) buffer.  (The fix is the
+   RoundFeed pattern: place a fresh batch per round, or pass host
+   numpy, which the jit re-places per call.)
+
+Donating callables are found two ways: ``X = jax.jit(...,
+donate_argnums=(...))`` assignments in the scanned module (``self._x``
+or bare names), plus the cross-module registry of the framework's
+known donating entry points (``KNOWN_DONATING`` — ``trainer._round``
+donates state AND batches since PR 3).
+
+The analysis is a small abstract interpreter over each function body:
+``dead`` maps name -> donation line; stores revive, loads of dead
+names report.  ``if``/``try`` branches fork the state and merge by
+union (possibly-dead is worth reporting); loop bodies run twice so the
+second pass models the next iteration.
+
+Suppression: ``# sparknet: donation-ok(<reason>)`` on the reusing
+statement (legit when the caller re-places before reuse, or the reuse
+is host numpy handed to a donated jit param — numpy args stay valid).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from sparknet_tpu.analysis import astutil
+from sparknet_tpu.analysis.findings import Finding, Markers, Report, Suppressed
+
+CHECKER = "donation-discipline"
+MARKER = "donation"
+
+# the framework's donating callables, by attribute name: call sites in
+# ANY scanned module are held to these positions (trainers.py /
+# solver.py construct them; see their donation comments)
+KNOWN_DONATING: Dict[str, Tuple[int, ...]] = {
+    "_round": (0, 1),      # ParameterAveragingTrainer: state AND batches
+    "_jit_round": (0,),    # AllReduceTrainer: state
+    "_jit_step": (0,),     # Solver: state
+}
+
+
+def _donate_positions(call: ast.Call) -> Tuple[int, ...]:
+    """donate_argnums of a ``jax.jit(...)`` call, () when absent or
+    non-literal."""
+    kw = astutil.kwarg(call, "donate_argnums")
+    if kw is None:
+        return ()
+    if isinstance(kw, ast.Constant) and isinstance(kw.value, int):
+        return (kw.value,)
+    if isinstance(kw, (ast.Tuple, ast.List)):
+        out = []
+        for el in kw.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                out.append(el.value)
+        return tuple(out)
+    return ()
+
+
+def collect_module_donators(tree: ast.Module) -> Dict[str, Tuple[int, ...]]:
+    """``name -> donated positions`` for every ``X = jax.jit(...,
+    donate_argnums=...)`` assignment in the module (the last attribute
+    segment of the target: ``self._step`` registers ``_step``)."""
+    out: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        call = node.value
+        if not isinstance(call, ast.Call):
+            continue
+        name = astutil.dotted(call.func)
+        if name not in ("jax.jit", "jit"):
+            continue
+        pos = _donate_positions(call)
+        if not pos:
+            continue
+        for tgt in node.targets:
+            t = astutil.dotted(tgt)
+            if t:
+                out[t.split(".")[-1]] = pos
+    return out
+
+
+class _Scope:
+    """One function's interpretation: dead-name tracking + reporting."""
+
+    def __init__(self, qual: str, relpath: str, markers: Markers,
+                 donators: Dict[str, Tuple[int, ...]], rep: Report):
+        self.qual = qual
+        self.relpath = relpath
+        self.markers = markers
+        self.donators = donators
+        self.rep = rep
+        self.reported: Set[Tuple[str, int]] = set()
+
+    # ---- expression walk: loads check deadness, donating calls kill --
+    def expr(self, node: ast.AST, dead: Dict[str, int]) -> None:
+        if isinstance(node, ast.Call):
+            callee = astutil.dotted(node.func)
+            leaf = callee.split(".")[-1] if callee else None
+            donated = self.donators.get(leaf, ()) if leaf else ()
+            self.expr(node.func, dead)
+            for a in node.args:
+                self.expr(a, dead)
+            for kw in node.keywords:
+                self.expr(kw.value, dead)
+            for p in donated:
+                if p < len(node.args) and isinstance(node.args[p], ast.Name):
+                    dead[node.args[p].id] = node.lineno
+            return
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            self._check_load(node, dead)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # separate scope
+        for child in ast.iter_child_nodes(node):
+            self.expr(child, dead)
+
+    def _check_load(self, node: ast.Name, dead: Dict[str, int]) -> None:
+        if node.id not in dead:
+            return
+        key = (node.id, node.lineno)
+        if key in self.reported:
+            return
+        self.reported.add(key)
+        # the donation line stays OUT of the message: Finding.key is
+        # the allowlist baseline key and must not shift with the file
+        msg = (
+            f"'{node.id}' used after being passed in a donated "
+            "position (donated buffers are deleted on real chips)"
+        )
+        reason = self.markers.covers(MARKER, node.lineno, node.lineno)
+        if reason is not None:
+            self.rep.suppressed.append(Suppressed(
+                CHECKER, self.relpath, node.lineno, self.qual, msg, reason,
+            ))
+        else:
+            self.rep.findings.append(Finding(
+                checker=CHECKER, path=self.relpath, line=node.lineno,
+                scope=self.qual, message=msg,
+                fixit="re-place (or rebuild) the buffer before reuse, "
+                "pass host numpy instead of a placed array, or annotate "
+                "with # sparknet: donation-ok(<why it is still valid>)",
+            ))
+
+    # ---- statement walk -------------------------------------------------
+    def stores(self, tgt: ast.AST, dead: Dict[str, int]) -> None:
+        if isinstance(tgt, ast.Name):
+            dead.pop(tgt.id, None)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self.stores(el, dead)
+        elif isinstance(tgt, ast.Starred):
+            self.stores(tgt.value, dead)
+        elif isinstance(tgt, (ast.Attribute, ast.Subscript)):
+            # storing INTO x.attr / x[i] reads x — a load, not a rebind
+            self.expr(tgt.value, dead)
+            if isinstance(tgt, ast.Subscript):
+                self.expr(tgt.slice, dead)
+
+    def block(self, body: List[ast.stmt], dead: Dict[str, int]) -> None:
+        for stmt in body:
+            self.stmt(stmt, dead)
+
+    def stmt(self, stmt: ast.stmt, dead: Dict[str, int]) -> None:
+        if isinstance(stmt, ast.Assign):
+            self.expr(stmt.value, dead)
+            for t in stmt.targets:
+                self.stores(t, dead)
+        elif isinstance(stmt, ast.AugAssign):
+            if isinstance(stmt.target, ast.Name):
+                self._check_load(
+                    ast.copy_location(
+                        ast.Name(id=stmt.target.id, ctx=ast.Load()),
+                        stmt.target,
+                    ),
+                    dead,
+                )
+            self.expr(stmt.value, dead)
+            self.stores(stmt.target, dead)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self.expr(stmt.value, dead)
+            self.stores(stmt.target, dead)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self.expr(stmt.iter, dead)
+            for _pass in range(2):   # second pass = next iteration
+                self.stores(stmt.target, dead)
+                self.block(stmt.body, dead)
+            self.block(stmt.orelse, dead)
+        elif isinstance(stmt, ast.While):
+            for _pass in range(2):
+                self.expr(stmt.test, dead)
+                self.block(stmt.body, dead)
+            self.block(stmt.orelse, dead)
+        elif isinstance(stmt, ast.If):
+            self.expr(stmt.test, dead)
+            d_then = dict(dead)
+            self.block(stmt.body, d_then)
+            d_else = dict(dead)
+            self.block(stmt.orelse, d_else)
+            dead.clear()
+            dead.update(d_else)
+            dead.update(d_then)   # union: possibly-dead reports
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self.expr(item.context_expr, dead)
+                if item.optional_vars is not None:
+                    self.stores(item.optional_vars, dead)
+            self.block(stmt.body, dead)
+        elif isinstance(stmt, ast.Try):
+            self.block(stmt.body, dead)
+            post_body = dict(dead)
+            for h in stmt.handlers:
+                d_h = dict(post_body)
+                self.block(h.body, d_h)
+                dead.update(d_h)
+            self.block(stmt.orelse, dead)
+            self.block(stmt.finalbody, dead)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            pass  # separate scope; visited on its own
+        elif isinstance(stmt, (ast.Return, ast.Expr, ast.Raise,
+                               ast.Assert, ast.Delete)):
+            for child in ast.iter_child_nodes(stmt):
+                self.expr(child, dead)
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self.expr(child, dead)
+                elif isinstance(child, ast.stmt):
+                    self.stmt(child, dead)
+
+
+def check_module(tree: ast.Module, relpath: str, markers: Markers) -> Report:
+    rep = Report()
+    donators = dict(KNOWN_DONATING)
+    donators.update(collect_module_donators(tree))
+    for qual, fn in astutil.collect_functions(tree).items():
+        scope = _Scope(qual, relpath, markers, donators, rep)
+        scope.block(fn.body, {})
+    return rep

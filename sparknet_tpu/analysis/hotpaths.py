@@ -1,0 +1,87 @@
+"""The hot-path registry: which scopes the sync checker polices.
+
+"Hot path" means code that runs once per round (or per request) in
+steady state, where one implicit device->host sync erases the overlap
+the PIPELINE/COMM/PROFILE artifacts measure — the trainer round/step
+bodies, the RoundFeed/Prefetcher producer machinery, the comm plane's
+dispatch/pace/apply path, the serving forward loop, and the span
+fast path.  Setup code (solver construction, checkpoint restore,
+dataset staging) deliberately is NOT here: syncing at build time is
+free.
+
+Two sources make a scope hot:
+
+1. this explicit registry — ``module-relative path -> qualnames``
+   (``Class.method`` or bare function names);
+2. any function passed as ``target=`` to ``threading.Thread`` in a
+   scanned module (producer/comm/watchdog threads are hot by
+   construction — that is where a stray sync silently serializes the
+   overlap).
+
+Extending: when a new module grows a per-round loop, add its qualnames
+here — the whole-repo ``tools/lint.py --check`` then polices it, and
+any deliberate sync it keeps must carry a ``# sparknet:
+sync-ok(<reason>)`` marker (ARCHITECTURE.md "Static analysis &
+sanitizers").
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet
+
+HOT_PATHS: Dict[str, FrozenSet[str]] = {
+    "solver.py": frozenset({
+        "Solver.step",
+        "Solver.note_losses",
+    }),
+    "data/round_feed.py": frozenset({
+        "RoundFeed._produce_one",
+        "RoundFeed._default_place",
+        "RoundFeed.next_round",
+        "stack_windows",
+    }),
+    "data/prefetch.py": frozenset({
+        "Prefetcher._run",
+        "Prefetcher._put_politely",
+        "Prefetcher.__next__",
+    }),
+    "parallel/trainers.py": frozenset({
+        "ParameterAveragingTrainer.round",
+        "ParameterAveragingTrainer._place_live",
+        "AllReduceTrainer.step",
+    }),
+    "parallel/comm.py": frozenset({
+        "CommPlane.round",
+        "CommPlane._dispatch_chunks",
+        "CommPlane._pace_chunks",
+        "CommPlane._apply_pending_correction",
+        "CommPlane._local_call",
+        "CommPlane._join_pending",
+        "CommPlane.flush_quant_error",
+    }),
+    "serve/engine.py": frozenset({
+        "InferenceEngine.run_padded",
+        "InferenceEngine.infer",
+    }),
+    "serve/batcher.py": frozenset({
+        "MicroBatcher._take_batch",
+        "MicroBatcher._loop",
+        "MicroBatcher.submit",
+    }),
+    "obs/trace.py": frozenset({
+        "_Span.__exit__",
+        "span",
+        "instant",
+    }),
+    "obs/profile.py": frozenset({
+        "RoundProfiler.probe_execute",
+        "RoundProfiler.observe_round",
+    }),
+    "utils/timers.py": frozenset({
+        "Timer.stop",
+    }),
+}
+
+
+def hot_scopes_for(relpath: str) -> FrozenSet[str]:
+    return HOT_PATHS.get(relpath, frozenset())

@@ -1,0 +1,79 @@
+"""The canonical trace/metrics name registry.
+
+One authoritative inventory of every ``sparknet_*`` metric the
+framework emits (``obs/__init__.py`` TrainingMetrics) and every
+``span(...)`` name by category — the sets the folding side consumes:
+``tools/trace_report.py`` (comm-span folding), ``tools/perf_gate.py``
+(live-profile fields), the PERF.md "Telemetry reference" tables, and
+the ``/metrics`` scrapers people build dashboards on.
+
+``analysis/registry_audit.py`` cross-checks this module against the
+code, both directions: an emitter whose name is missing here fails the
+lint (a dashboard can't find it, ``trace_report`` won't fold it), and
+an entry here that nothing emits fails too (documentation of a ghost).
+Adding a metric/span is therefore a two-line change: the emitter and
+this registry (plus the PERF.md table row, which the audit also
+enforces).  Import cost discipline: this module must stay stdlib-only
+— ``tools/trace_report.py`` imports it at CLI startup.
+"""
+
+from __future__ import annotations
+
+# metric name -> label names (() = unlabeled).  Only sparknet_* series
+# are canonical here; the serving stack's serve_* series live with the
+# serving code (a separate registry instance per server).
+CANONICAL_METRICS = {
+    "sparknet_uptime_seconds": (),
+    "sparknet_rounds_total": (),
+    "sparknet_iters_total": (),
+    "sparknet_phase_latency_seconds": ("phase",),
+    "sparknet_feed_queue_depth": (),
+    "sparknet_feed_stalls_total": (),
+    "sparknet_io_retries_total": (),
+    "sparknet_snapshots_total": (),
+    "sparknet_restores_total": (),
+    "sparknet_snapshots_quarantined_total": (),
+    "sparknet_faults_total": ("kind",),
+    "sparknet_cache_hits_total": (),
+    "sparknet_cache_misses_total": (),
+    "sparknet_cache_evictions_total": (),
+    "sparknet_cache_bytes_total": ("src",),
+    "sparknet_collective_bytes_total": ("compress",),
+    "sparknet_quant_error_max_abs": ("compress",),
+    "sparknet_quant_snr_db": ("compress",),
+    "sparknet_hidden_fraction": ("kind",),
+    "sparknet_worker_skew": (),
+    "sparknet_straggler_worker": (),
+    "sparknet_straggler_rounds_total": (),
+    "sparknet_achieved_flops": (),
+    "sparknet_mfu": (),
+    "sparknet_jit_cache_size": (),
+    "sparknet_device_bytes": (),
+    "sparknet_host_rss_bytes": (),
+    "sparknet_grad_norm": (),
+    "sparknet_nonfinite_total": (),
+    "sparknet_update_ratio": ("group",),
+    "sparknet_health_anomalies_total": ("kind",),
+    "sparknet_health_rollbacks_total": (),
+}
+
+# span names by category.  "phase" spans additionally feed the
+# sparknet_phase_latency_seconds{phase=...} histogram, so this set IS
+# that family's label vocabulary.
+CANONICAL_SPANS = {
+    "phase": frozenset({
+        "assemble", "h2d", "execute", "average",
+        "quantize", "allreduce", "dequantize",
+        "snapshot", "restore", "verify",
+    }),
+    "cache": frozenset({"cache_read", "cache_fetch"}),
+}
+
+# the comm-plane span triple tools/trace_report.py folds into its
+# compressed-collective section (kept here so the folder and the
+# emitters cannot drift apart)
+COMM_SPANS = ("quantize", "allreduce", "dequantize")
+
+# doc tokens that look like metric names but aren't (the package
+# itself, the native runtime library)
+DOC_IGNORED_PREFIXES = ("sparknet_tpu", "sparknet_runtime")

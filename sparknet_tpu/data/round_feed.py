@@ -180,6 +180,7 @@ class RoundFeed:
                 # refilled; blocking HERE keeps the wait on the producer
                 # thread, still fully overlapped with the consumer's
                 # round execute
+                # sparknet: sync-ok(recycle handback: the H2D must land before the buffer refills; waits on the producer thread, overlapped under consumer execute)
                 jax.block_until_ready(dev)
                 self._buf = host  # adopt (first round) / keep the buffer
         return dev

@@ -143,7 +143,8 @@ class TrainingMetrics:
         self.phase_latency = registry.histogram(
             "sparknet_phase_latency_seconds",
             "wall seconds per round phase (assemble/h2d/execute/average/"
-            "quantize/allreduce/dequantize/snapshot/restore)",
+            "quantize/allreduce/dequantize/snapshot/restore/verify — "
+            "the canonical phase set in analysis/registry.py)",
             labels=("phase",),
         )
         self.feed_queue_depth = registry.gauge(

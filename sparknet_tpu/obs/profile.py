@@ -326,6 +326,7 @@ class RoundProfiler:
             done = []
             for w, d in pending.items():
                 if not can_poll:
+                    # sparknet: sync-ok(the execute probe IS the profiler's one deliberate per-round sync — disclosed in PROFILE_r11)
                     jax.block_until_ready(d)
                 if not can_poll or d.is_ready():
                     times[w] = time.perf_counter() - t0
@@ -420,6 +421,7 @@ class RoundProfiler:
             wt = dict(wt, execute_probe=probe)
         worker = self._worker_verdict(r, wt)
         rec = {
+            # sparknet: sync-ok(host round index from note_consumed_round, never a device value)
             "round": int(r),
             "round_s": round_s,
             "phases_ms": {

@@ -475,6 +475,7 @@ class CommPlane:
         """Wait for the in-flight chunk collectives; re-raise comm-
         thread errors on the caller."""
         p = self._pending
+        # sparknet: join-ok(bounded by the in-flight chunk collectives: _pace_chunks always terminates, storing errors instead of raising)
         p["thread"].join()
         holder = p["holder"]
         if holder.get("error") is not None:
@@ -531,11 +532,13 @@ class CommPlane:
             # pacing chunks — in overlap mode this is the comm thread
             # parking until round r's window is done, in barriered mode
             # it keeps the round an honest local-then-collective sum
+            # sparknet: sync-ok(the wire wait: comm thread parks until the encode lands — overlapped in overlap mode, the deliberate barrier otherwise)
             jax.block_until_ready(q)
             means: list = [None] * len(q)
             for sl, m, nbytes in outs:
                 with obs.span("allreduce", chunk=sl.start, nbytes=nbytes):
                     self._sleep_cost(nbytes)
+                    # sparknet: sync-ok(chunk landing: the span times the wire, not the dispatch — comm-thread side of the overlap)
                     jax.block_until_ready(m)
                 means[sl] = list(m)
             holder["means"] = means
@@ -576,9 +579,11 @@ class CommPlane:
         from sparknet_tpu import obs as _obs
 
         max_abs, delta_sq, err_sq = (
+            # sparknet: sync-ok(3-scalar readout dispatched with LAST round's encode — ready by now, fetched without stalling the dispatch path)
             float(v) for v in jax.device_get(pending)
         )
         if err_sq > 0:
+            # sparknet: sync-ok(host floats fetched above — pure host math)
             snr_db = 10.0 * float(np.log10(max(delta_sq, 1e-45) / err_sq))
         else:
             snr_db = 300.0  # error underflowed to exactly 0
@@ -680,8 +685,10 @@ class CommPlane:
         # side: live_host is host data already; the in-graph audit
         # verdict costs one tiny (num_workers,) read — the same
         # per-round D2H budget the host sentry already pays.
+        # sparknet: sync-ok(live_host is the host-side mask, never a device array)
         all_alive = bool(np.all(np.asarray(live_host) > 0))
         if all_alive and self.mask_nonfinite:
+            # sparknet: sync-ok(one tiny (num_workers,) audit-verdict read — the same per-round D2H budget the host sentry pays; documented above)
             all_alive = not bool(np.any(np.asarray(jax.device_get(bad)) > 0))
 
         outs, denom0 = self._dispatch_chunks(q, scales, alive)

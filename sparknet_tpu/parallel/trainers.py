@@ -38,7 +38,7 @@ except AttributeError:  # pragma: no cover
 from sparknet_tpu import obs
 from sparknet_tpu.obs import profile as obs_profile
 from sparknet_tpu.solver import Solver, TrainState
-from sparknet_tpu.utils.rngs import train_key
+from sparknet_tpu.utils.rngs import default_train_key
 
 tree_map = jax.tree_util.tree_map
 
@@ -440,6 +440,7 @@ class ParameterAveragingTrainer:
         Cached per distinct mask value — the loops pass the same mask
         round after round (all-alive, or one fixed fault pattern), so
         the placement happens once, not once per round."""
+        # sparknet: sync-ok(live_mask is a host 0/1 array, never a device value; placement cached per mask)
         live = np.asarray(live_mask, np.float32).reshape(-1)
         if live.shape[0] != self.num_workers:
             raise ValueError(
@@ -482,7 +483,7 @@ class ParameterAveragingTrainer:
         stats)`` where ``stats`` is the per-worker audit tree (leaves
         (num_workers, tau); plus ``masked`` (num_workers,) when the
         in-graph non-finite mask is armed)."""
-        rng = rng if rng is not None else train_key(0)
+        rng = rng if rng is not None else default_train_key(0)
         # "average" is the whole averaging round (this method IS one
         # round of the SparkNet algorithm); "execute" nests inside it as
         # the fused XLA program's dispatch/execution.  Span timing stays
@@ -734,7 +735,7 @@ class AllReduceTrainer:
         numerics audit on (readable here at step time — the jit's
         output sharding is a pytree prefix, so no rebuild is needed),
         returns ``(state, losses, stats)``."""
-        rng = rng if rng is not None else train_key(0)
+        rng = rng if rng is not None else default_train_key(0)
         audit = bool(getattr(self.solver, "audit", False))
         stats = None
         with obs.span("execute"):

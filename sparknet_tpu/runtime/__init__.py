@@ -443,7 +443,9 @@ class DataPipeline:
                     except _queue.Full:
                         continue
 
-        self._py_thread = threading.Thread(target=run, daemon=True)
+        self._py_thread = threading.Thread(
+            target=run, name="snpipe-producer", daemon=True
+        )
         self._py_thread.start()
 
     def next(self):

@@ -190,13 +190,16 @@ class InferenceEngine:
             )
         with self._lock:
             out = self._fwd(
+                # sparknet: sync-ok(host request payload coerced before the put — x never holds a device array)
                 self.params, self.stats, np.asarray(x, np.float32)
             )
+        # sparknet: sync-ok(serving D2H: materializing the response rows IS the product)
         return np.asarray(out)
 
     def infer(self, x: np.ndarray) -> np.ndarray:
         """Single-shot inference for n items (any n >= 1): chunks by the
         max bucket, pads the tail, returns exactly n output rows."""
+        # sparknet: sync-ok(host request payload coerced once at the API edge)
         x = np.asarray(x, np.float32)
         if x.ndim == len(self.item_shape):  # single item without batch dim
             x = x[None]

@@ -38,7 +38,7 @@ from sparknet_tpu.obs import profile as _profile
 from sparknet_tpu.config import load_net_prototxt
 from sparknet_tpu.config.schema import NetParameter, SolverParameter, solver_method
 from sparknet_tpu.net import JaxNet, Params, Stats
-from sparknet_tpu.utils.rngs import train_key
+from sparknet_tpu.utils.rngs import default_train_key
 
 
 class TrainState(NamedTuple):
@@ -364,7 +364,7 @@ class Solver:
         """Run ``tau`` iterations on the SAME device-resident batch inside
         one jitted program.  One dispatch for the whole window — use for
         throughput measurement (bench.py) or single-batch overfit tests."""
-        rng = rng if rng is not None else train_key(0)
+        rng = rng if rng is not None else default_train_key(0)
         if not hasattr(self, "_jit_step_repeat"):
             self._jit_step_repeat = jax.jit(
                 self._step_repeat, donate_argnums=(0,), static_argnums=(3,)
@@ -386,7 +386,7 @@ class Solver:
         ccaffe.cpp:230-233).  Returns (new_state, per-iter losses) — or
         (new_state, losses, audit_stats) when the numerics audit is on
         (``audit=True``; see obs/health.py)."""
-        rng = rng if rng is not None else train_key(0)
+        rng = rng if rng is not None else default_train_key(0)
         if self.param.debug_info:
             first = jax.tree_util.tree_map(lambda x: x[0], batches)
             self.debug_info_pass(state, first, rng=rng)
@@ -457,7 +457,7 @@ class Solver:
         import sys
 
         log = log or (lambda s: print(s, file=sys.stderr))
-        rng = rng if rng is not None else train_key(0)
+        rng = rng if rng is not None else default_train_key(0)
         net = self.net
 
         def asum(x):

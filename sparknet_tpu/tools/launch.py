@@ -121,6 +121,7 @@ def spawn_local(args, app_argv) -> int:
         # pipe while an earlier one waits on it in a collective
         t = threading.Thread(
             target=lambda p=p, buf=outputs[-1]: buf.extend(p.stdout),
+            name=f"launch-drain-p{pid}",
             daemon=True,
         )
         t.start()

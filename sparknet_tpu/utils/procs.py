@@ -156,10 +156,12 @@ def timed(average_params):
     )
     state = trainer.init_state(seed=0)
     state, losses = trainer.round(state, make_batches())  # compile + warm
+    # sparknet: sync-ok(A/B timing harness: the sync closes the clock, identical in both legs)
     jax.block_until_ready(losses)
     t0 = time.perf_counter()
     for _ in range(ROUNDS):
         state, losses = trainer.round(state, make_batches())
+    # sparknet: sync-ok(A/B timing harness: the sync closes the clock, identical in both legs)
     jax.block_until_ready(losses)
     return (time.perf_counter() - t0) / ROUNDS
 
@@ -227,7 +229,10 @@ def run_two_process_round(
             results[pid] = (p.returncode, out)
 
         threads = [
-            threading.Thread(target=reap, args=(pid, p), daemon=True)
+            threading.Thread(
+                target=reap, args=(pid, p), name=f"procs-reap-p{pid}",
+                daemon=True,
+            )
             for pid, p in enumerate(procs)
         ]
         for t in threads:

@@ -14,14 +14,37 @@ threefry everywhere so filler golden tests are backend-independent.
 
 from __future__ import annotations
 
+import functools
 import os
 
 import jax
 
 
-def train_key(seed: int = 0) -> jax.Array:
-    """A typed PRNG key for training-step randomness (see module doc)."""
+def _default_impl() -> str:
     impl = os.environ.get("SPARKNET_PRNG")
     if impl is None:
         impl = "rbg" if jax.default_backend() == "tpu" else "threefry2x32"
+    return impl
+
+
+def train_key(seed: int = 0) -> jax.Array:
+    """A typed PRNG key for training-step randomness (see module doc)."""
+    return jax.random.key(seed, impl=_default_impl())
+
+
+@functools.lru_cache(maxsize=16)
+def _cached_train_key(seed: int, impl: str) -> jax.Array:
     return jax.random.key(seed, impl=impl)
+
+
+def default_train_key(seed: int = 0) -> jax.Array:
+    """``train_key`` for the hot-loop *default-rng* paths
+    (``trainer.round(..., rng=None)`` every round): the key is cached
+    per (seed, impl), so the per-round scalar host->device transfer a
+    fresh ``jax.random.key`` pays disappears — ``bench.py
+    --mode=sanitize`` runs the round loop under
+    ``jax.transfer_guard("disallow")`` and a fresh key per round is
+    exactly the class of silent implicit transfer it exists to catch.
+    (Keys are never consumed in place — reusing the cached array is
+    semantically identical to rebuilding it.)"""
+    return _cached_train_key(int(seed), _default_impl())

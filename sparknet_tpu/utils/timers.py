@@ -49,5 +49,6 @@ class Timer(CPUTimer):
 
     def stop(self):
         if self._sync_on is not None:
+            # sparknet: sync-ok(device-synchronized timer: the sync IS the contract, cudaEvent-style)
             jax.block_until_ready(self._sync_on)
         return super().stop()

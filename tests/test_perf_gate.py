@@ -150,6 +150,42 @@ def test_datacache_family_rules(tmp_path):
     )
 
 
+GOOD_SANITIZE = {
+    "value": 6, "rounds_guarded": 6, "disallowed_transfers": 0,
+    "recompiles_post_warmup": 0, "guard_armed": True,
+    "leak_check_ok": True, "lint_new_findings": 0,
+    "annotated_sync_count": 17,
+}
+
+
+def test_sanitize_family_rules(tmp_path):
+    """The SANITIZE family (ISSUE 9): zero disallowed transfers, zero
+    post-warmup recompiles, >= 5 guarded rounds, an armed guard, and a
+    clean lint — any one regressing fails --check."""
+    g = _gate()
+    _write(tmp_path, "SANITIZE_r13.json", GOOD_SANITIZE)
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 0, rows
+    for bad_field, bad_value in (
+        ("disallowed_transfers", 1),
+        ("recompiles_post_warmup", 2),
+        ("guard_armed", False),       # vacuous zero: guard never bit
+        ("leak_check_ok", False),
+        ("lint_new_findings", 3),
+        ("rounds_guarded", 4),        # under the >= 5 steady-round bar
+        ("annotated_sync_count", 0),  # empty inventory = unaudited
+    ):
+        _write(
+            tmp_path, "SANITIZE_r14.json",
+            dict(GOOD_SANITIZE, **{bad_field: bad_value}),
+        )
+        rc, rows = g.check(str(tmp_path))
+        assert rc == 1, bad_field
+        assert any(
+            bad_field in r["detail"] for r in rows if not r["ok"]
+        ), (bad_field, rows)
+
+
 def test_missing_key_is_a_failure_not_a_pass(tmp_path):
     g = _gate()
     _write(tmp_path, "OBS_r09.json", {"overhead_traced_pct": 0.5})

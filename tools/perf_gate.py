@@ -130,6 +130,23 @@ RULES: Dict[str, List[Rule]] = {
         Rule("hidden_frac_h2d_p50", ">", 0.0),
         Rule("flops_cross_check_ratio", ">", 0.0),
     ],
+    "SANITIZE": [
+        # the hot-path invariant contract (bench.py --mode=sanitize,
+        # the dynamic half of tools/lint.py): >=5 steady-state
+        # pipelined rounds under jax.transfer_guard(disallow) with
+        # zero disallowed transfers and a flat jit cache, the guard
+        # proven armed by a control, one fresh-compile round under
+        # jax.checking_leaks, zero new lint findings, and a non-empty
+        # enumerated deliberate-sync inventory
+        Rule("value", ">=", 5),
+        Rule("rounds_guarded", ">=", 5),
+        Rule("disallowed_transfers", "==", 0),
+        Rule("recompiles_post_warmup", "==", 0),
+        Rule("guard_armed", "is", True),
+        Rule("leak_check_ok", "is", True),
+        Rule("lint_new_findings", "==", 0),
+        Rule("annotated_sync_count", ">", 0),
+    ],
     "DATACACHE": [
         # the I/O-flat contract: a warm (cache-filled, shuffled-
         # assignment) epoch makes ZERO network fetches and is strictly

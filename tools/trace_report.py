@@ -20,10 +20,17 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Dict, List
 
-COMM_SPANS = ("quantize", "allreduce", "dequantize")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+# one vocabulary for the comm-span triple: the canonical registry the
+# lint's registry audit holds the EMITTERS to (stdlib-only import)
+from sparknet_tpu.analysis.registry import COMM_SPANS  # noqa: E402
 
 
 def load_events(path: str) -> List[dict]:
