@@ -55,6 +55,22 @@ CANONICAL_METRICS = {
     "sparknet_update_ratio": ("group",),
     "sparknet_health_anomalies_total": ("kind",),
     "sparknet_health_rollbacks_total": (),
+    # fleet shipper (obs/ship.py, --ship_to) — per-host push side
+    "sparknet_ship_events_total": (),
+    "sparknet_ship_dropped_total": (),
+    "sparknet_ship_pushes_total": (),
+    "sparknet_ship_push_failures_total": (),
+    # fleet collector (obs/fleet.py, --fleet_collector) — the merged
+    # cross-host families on the collector's own /metrics
+    "sparknet_fleet_hosts": ("state",),
+    "sparknet_fleet_round": ("host",),
+    "sparknet_fleet_round_skew": (),
+    "sparknet_fleet_clock_offset_seconds": ("host",),
+    "sparknet_fleet_events_total": ("host",),
+    "sparknet_fleet_dropped_events_total": ("host",),
+    "sparknet_fleet_lost_events_total": ("host",),
+    "sparknet_fleet_pushes_total": ("host",),
+    "sparknet_fleet_resets_total": ("host",),
 }
 
 # span names by category.  "phase" spans additionally feed the

@@ -47,8 +47,13 @@ from typing import Optional
 from sparknet_tpu.obs import flight  # noqa: F401
 from sparknet_tpu.obs import profile as profile  # noqa: F401
 from sparknet_tpu.obs.exporter import JsonHTTPHandler, ObsExporter  # noqa: F401
+from sparknet_tpu.obs.fleet import (  # noqa: F401
+    DEFAULT_FLEET_PORT,
+    FleetCollector,
+)
 from sparknet_tpu.obs.flight import FlightRecorder  # noqa: F401
 from sparknet_tpu.obs.profile import RoundProfiler  # noqa: F401
+from sparknet_tpu.obs.ship import Shipper  # noqa: F401
 from sparknet_tpu.obs.metrics import (  # noqa: F401
     LATENCY_BUCKETS_S,
     Counter,
@@ -64,6 +69,7 @@ from sparknet_tpu.obs.trace import (  # noqa: F401
     instant,
     jsonl_path_for,
     set_phase_observer,
+    set_ship,
     span,
     uninstall_tracer,
 )
@@ -292,6 +298,27 @@ class TrainingMetrics:
             "sparknet_health_rollbacks_total",
             "sentry-triggered rollbacks to a verified snapshot",
         )
+        # fleet-shipper series (obs/ship.py, --ship_to) — zero until a
+        # run ships to a fleet collector
+        self.ship_events = registry.counter(
+            "sparknet_ship_events_total",
+            "run-log events enqueued for shipping to the fleet "
+            "collector (includes later-dropped ones)",
+        )
+        self.ship_dropped = registry.counter(
+            "sparknet_ship_dropped_total",
+            "buffered events dropped (oldest first) at the shipper's "
+            "bound while the collector was unreachable",
+        )
+        self.ship_pushes = registry.counter(
+            "sparknet_ship_pushes_total",
+            "successful pushes to the fleet collector",
+        )
+        self.ship_push_failures = registry.counter(
+            "sparknet_ship_push_failures_total",
+            "pushes that exhausted their retry budget (collector "
+            "unreachable; events stayed buffered)",
+        )
 
 
 _lock = threading.Lock()
@@ -332,6 +359,7 @@ def _reset_training_metrics_for_tests() -> None:
         _unhealthy_reason = None
         _sentry = None
         set_phase_observer(None)
+        set_ship(None)
     flight.uninstall()
     profile.uninstall()
 
@@ -434,6 +462,27 @@ def add_cli_args(parser) -> None:
         "compare this run against the committed baselines",
     )
     parser.add_argument(
+        "--ship_to", default=None, metavar="http://HOST:PORT",
+        help="ship this process's metric deltas + run-log events to a "
+        "fleet collector (obs/ship.py; dedicated thread, bounded "
+        "buffer, retry backoff — training never blocks on the network)",
+    )
+    parser.add_argument(
+        "--fleet_collector", nargs="?",
+        const=f"127.0.0.1:{DEFAULT_FLEET_PORT}", default=None,
+        metavar="HOST:PORT",
+        help="start the fleet collector in this process (obs/fleet.py: "
+        "cross-host metric/event merge, clock-aligned /trace + "
+        "/runlog, global /fleet + /metrics with live|late|dead "
+        "attribution).  Without --ship_to this process also ships to "
+        "its own collector",
+    )
+    parser.add_argument(
+        "--host_id", default=None,
+        help="this process's identity in the fleet view (default: "
+        "$SPARKNET_HOST_ID, else hostname:pid)",
+    )
+    parser.add_argument(
         "--flight_recorder", nargs="?",
         const=flight.DEFAULT_BUNDLE_PATH, default=None,
         metavar="BUNDLE.json",
@@ -461,7 +510,9 @@ class ObsRun:
                  metrics: Optional[TrainingMetrics] = None,
                  recorder: Optional[FlightRecorder] = None,
                  profiler: Optional["RoundProfiler"] = None,
-                 echo=None, profile_out: Optional[str] = None):
+                 echo=None, profile_out: Optional[str] = None,
+                 shipper: Optional["Shipper"] = None,
+                 collector: Optional["FleetCollector"] = None):
         self.exporter = exporter
         self.tracer = tracer
         self.trace_out = trace_out
@@ -469,6 +520,8 @@ class ObsRun:
         self.recorder = recorder
         self.profiler = profiler
         self.profile_out = profile_out
+        self.shipper = shipper
+        self.collector = collector
         self._echo = echo
         self._closed = False
 
@@ -512,6 +565,18 @@ class ObsRun:
             # clean close: detach WITHOUT dumping (bundles are
             # postmortems; any already-dumped one stays on disk)
             flight.uninstall(self.recorder)
+        if self.shipper is not None:
+            # detach the trace hook FIRST (no events enqueue during the
+            # final flush), then stop — stop() ships the buffered tail
+            from sparknet_tpu.obs import trace as _trace
+
+            if _trace._ship is self.shipper:
+                set_ship(None)
+            self.shipper.stop()
+        if self.collector is not None:
+            # after the shipper's final flush, so a local collector
+            # sees this run's tail before the listener goes down
+            self.collector.close()
         # the run's divergence sentry is scoped to the run as well: a
         # later run in this process must not inherit a halted /healthz
         # or embed this run's verdicts in its flight bundles
@@ -570,17 +635,25 @@ def start(
     flight_out: Optional[str] = None,
     profile_rounds: bool = False,
     profile_out: Optional[str] = None,
+    ship_to: Optional[str] = None,
+    fleet_collector: Optional[str] = None,
+    host_id: Optional[str] = None,
     echo=print,
 ) -> ObsRun:
     """Turn telemetry on for this run: ``metrics=True`` starts the
     /metrics + /healthz sidecar; ``trace_out`` installs the tracer;
     ``flight_out`` installs the crash flight recorder (bundle path);
-    ``profile_rounds`` installs the round-anatomy profiler.
-    metrics/trace/profile also enable the training metric series (spans
-    feed the per-phase histogram).  Returns an ``ObsRun`` to
-    ``close()`` in the run's ``finally``."""
+    ``profile_rounds`` installs the round-anatomy profiler;
+    ``fleet_collector`` ("HOST:PORT") starts the cross-host fleet
+    collector in this process; ``ship_to`` (a collector URL) ships this
+    process's metric deltas + run-log events there — with a collector
+    but no ``ship_to`` the process ships to its own collector.
+    metrics/trace/profile/ship also enable the training metric series
+    (spans feed the per-phase histogram; the shipper snapshots it).
+    Returns an ``ObsRun`` to ``close()`` in the run's ``finally``."""
     profile_rounds = profile_rounds or bool(profile_out)
-    if not metrics and not trace_out and not flight_out and not profile_rounds:
+    if not any((metrics, trace_out, flight_out, profile_rounds, ship_to,
+                fleet_collector)):
         return ObsRun()
     recorder = None
     if flight_out:
@@ -595,8 +668,21 @@ def start(
                 "obs: round-anatomy profiler on (phase breakdown, "
                 "hidden fractions, straggler verdicts)"
             )
-    if not metrics and not trace_out and not profile_rounds:
-        return ObsRun(recorder=recorder, echo=echo)
+    collector = None
+    if fleet_collector:
+        from sparknet_tpu.obs.fleet import parse_hostport
+
+        chost, cport = parse_hostport(fleet_collector)
+        collector = FleetCollector(host=chost, port=cport).start()
+        if echo is not None:
+            echo(
+                "obs: fleet collector on %s/fleet (merged /metrics, "
+                "clock-aligned /trace + /runlog)" % collector.url
+            )
+        if not ship_to:
+            ship_to = collector.url  # one flag = a self-shipping fleet
+    if not any((metrics, trace_out, profile_rounds, ship_to)):
+        return ObsRun(recorder=recorder, collector=collector, echo=echo)
     tm = enable_training_metrics()
     exporter = None
     if metrics:
@@ -614,8 +700,20 @@ def start(
                 f"obs: tracing round phases -> {trace_out} "
                 f"(+ {jsonl_path_for(trace_out)})"
             )
+    shipper = None
+    if ship_to:
+        shipper = Shipper(
+            ship_to, host=host_id, registry=tm.registry
+        ).start()
+        set_ship(shipper)
+        if echo is not None:
+            echo(
+                "obs: shipping metric deltas + run-log events to "
+                "%s as host %r" % (shipper.url, shipper.host)
+            )
     return ObsRun(exporter, tracer, trace_out, tm, recorder, profiler, echo,
-                  profile_out=profile_out)
+                  profile_out=profile_out, shipper=shipper,
+                  collector=collector)
 
 
 def start_from_args(args, echo=print) -> ObsRun:
@@ -626,5 +724,8 @@ def start_from_args(args, echo=print) -> ObsRun:
         flight_out=getattr(args, "flight_recorder", None),
         profile_rounds=getattr(args, "profile", False),
         profile_out=getattr(args, "profile_out", None),
+        ship_to=getattr(args, "ship_to", None),
+        fleet_collector=getattr(args, "fleet_collector", None),
+        host_id=getattr(args, "host_id", None),
         echo=echo,
     )

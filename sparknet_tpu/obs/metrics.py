@@ -304,6 +304,24 @@ class MetricsRegistry:
         with self._lock:
             return self._metrics.get(name)
 
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        """One point-in-time read of every sample, keyed by the full
+        sample name (labels inline — ``m{kind="x"}`` — so label
+        families survive the round trip): ``{"counters": {...},
+        "gauges": {...}}``.  Histogram samples (buckets/sum/count) are
+        cumulative and fold under ``counters``.  This is the shipper's
+        read side (``obs/ship.py``): two snapshots + ``counter_deltas``
+        give the increment to push."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        counters: Dict[str, float] = {}
+        gauges: Dict[str, float] = {}
+        for m in metrics:
+            target = counters if m.TYPE in ("counter", "histogram") else gauges
+            for name, value in m.samples():
+                target[name] = float(value)
+        return {"counters": counters, "gauges": gauges}
+
     def render(self) -> str:
         """Prometheus text exposition format, version 0.0.4."""
         with self._lock:
@@ -316,3 +334,29 @@ class MetricsRegistry:
             for sample_name, value in m.samples():
                 lines.append(f"{sample_name} {_fmt(value)}")
         return "\n".join(lines) + "\n"
+
+
+def counter_deltas(
+    prev: Dict[str, float], cur: Dict[str, float]
+) -> Tuple[Dict[str, float], List[str]]:
+    """Monotonic-counter deltas between two ``snapshot()["counters"]``
+    reads, with Prometheus counter-reset semantics: a sample whose
+    value DROPPED restarted from zero (process restart, fresh
+    registry), so the new value IS the increment — history is never
+    un-counted.  Returns ``(deltas, reset_sample_names)``; zero deltas
+    are omitted (a quiet fleet ships empty payloads, not every name
+    every push).  Samples present in ``prev`` but missing from ``cur``
+    are ignored (a swapped registry's old families just stop
+    shipping)."""
+    deltas: Dict[str, float] = {}
+    resets: List[str] = []
+    for name, value in cur.items():
+        before = prev.get(name, 0.0)
+        if value < before:
+            resets.append(name)
+            d = value
+        else:
+            d = value - before
+        if d:
+            deltas[name] = d
+    return deltas, resets

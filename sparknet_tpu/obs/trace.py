@@ -175,6 +175,10 @@ _span_observer = None
 # the installed FlightRecorder's event ring (obs/flight.py; None = off)
 # — spans/instants feed it even when no Tracer is recording
 _flight = None
+# the installed fleet Shipper (obs/ship.py; None = off) — spans/
+# instants feed its bounded buffer the same way, stamped with wall
+# time so the collector can clock-align N hosts' records
+_ship = None
 
 
 def install_tracer(tracer: Tracer) -> Tracer:
@@ -211,6 +215,13 @@ def set_flight(recorder) -> None:
     owns the install/uninstall lifecycle)."""
     global _flight
     _flight = recorder
+
+
+def set_ship(shipper) -> None:
+    """Point span()/instant() at a fleet shipper's buffer (obs/ship.py;
+    the ObsRun owns the install/uninstall lifecycle)."""
+    global _ship
+    _ship = shipper
 
 
 class _NullSpan:
@@ -258,6 +269,20 @@ class _Span:
             if self.args:
                 rec["args"] = self.args
             f.record_event(rec)
+        sh = _ship
+        if sh is not None:
+            # full-resolution wall START time: the collector subtracts
+            # the per-host clock offset from t_s when merging, so the
+            # span lands on the fleet timeline where it began
+            rec = {
+                "kind": "span", "name": self.name, "cat": self.cat,
+                "t_s": time.time() - dur_s,
+                "dur_ms": round(dur_s * 1e3, 4),
+                "thread": threading.current_thread().name,
+            }
+            if self.args:
+                rec["args"] = self.args
+            sh.record_event(rec)
         obs = _phase_observer
         if obs is not None and self.cat == "phase":
             obs(self.name, dur_s)
@@ -280,6 +305,7 @@ def span(name: str, cat: str = "phase", **args):
         and _phase_observer is None
         and _flight is None
         and _span_observer is None
+        and _ship is None
     ):
         return _NULL_SPAN
     return _Span(name, cat, args or None)
@@ -301,6 +327,16 @@ def instant(name: str, cat: str = "event", **args) -> None:
         if args:
             rec["args"] = args
         f.record_event(rec)
+    sh = _ship
+    if sh is not None:
+        rec = {
+            "kind": "instant", "name": name, "cat": cat,
+            "t_s": time.time(),
+            "thread": threading.current_thread().name,
+        }
+        if args:
+            rec["args"] = args
+        sh.record_event(rec)
 
 
 def jsonl_path_for(trace_out: str) -> str:

@@ -127,6 +127,17 @@ class FaultPlan:
     # preemption: the cold-cache recovery is exercised on the resumed
     # process, the realistic case.
     cache_cold_round: Optional[int] = 5
+    # collector_outage: the fleet collector (obs/fleet.py) goes DOWN at
+    # the end of this round and comes back collector_outage_rounds
+    # rounds later (a crashed / partitioned observability plane).  The
+    # per-host shipper (obs/ship.py) must keep training unblocked,
+    # buffer the run-log events + metric deltas it cannot push, and
+    # REPLAY them when the collector returns — survived = zero lost
+    # events, zero dropped events, and the collector actually missed
+    # pushes while down (the outage really bit).  Resumes before the
+    # preemption so the two faults don't compound.
+    collector_outage_round: Optional[int] = 1
+    collector_outage_rounds: int = 2
 
     @classmethod
     def default(cls) -> "FaultPlan":
@@ -145,6 +156,7 @@ class FaultPlan:
             straggler_round=None,
             cache_corrupt_round=None,
             cache_cold_round=None,
+            collector_outage_round=None,
         )
 
 
@@ -222,6 +234,110 @@ def corrupt_file(path: str, seed: int = 0) -> None:
         orig = f.read(16)
         f.seek(off)
         f.write(bytes((b ^ 0xA5) for b in orig) or bytes([rng.randrange(256)]))
+
+
+class _CollectorOutage:
+    """The collector_outage fault: a live fleet collector + this
+    process's shipper, with the collector torn down for a planned span
+    of rounds.  ``on_round_end`` drives pause/resume by absolute round
+    (fires once — resume replays can't re-trip it); ``finalize`` stops
+    the shipper (final tail flush) and judges survival: the collector
+    missed pushes while down, yet ended with every enqueued event
+    delivered (0 lost, 0 dropped)."""
+
+    def __init__(self, plan: FaultPlan, counters: Dict, note):
+        from sparknet_tpu.obs import trace as _trace
+        from sparknet_tpu.obs.fleet import FleetCollector
+        from sparknet_tpu.obs.ship import Shipper
+
+        self.plan = plan
+        self.counters = counters
+        self.note = note
+        self.collector = FleetCollector(port=0).start()
+        self.shipper = Shipper(
+            self.collector.url, host="chaos-host", interval_s=0.1
+        ).start()
+        # a surrounding --ship_to run's shipper is restored on close —
+        # the chaos-local shipper must not permanently steal the hook
+        self._prev_ship = _trace._ship
+        _obs.set_ship(self.shipper)
+        self._down_at: Optional[int] = plan.collector_outage_round
+        self._up_at = (
+            plan.collector_outage_round + plan.collector_outage_rounds
+        )
+        self._received_at_pause: Optional[int] = None
+        self.summary: Optional[Dict] = None
+
+    def _host_state(self) -> Dict:
+        return self.collector.fleet_view()["hosts"].get("chaos-host", {})
+
+    def on_round_end(self, r: int) -> None:
+        if self._down_at is not None and r == self._down_at:
+            self._down_at = None
+            self._received_at_pause = self._host_state().get(
+                "received_events", 0
+            )
+            self.collector.pause()
+            self.counters["collector_outage_injected"] = 1
+            _obs.fault(
+                "collector_outage", round=r,
+                down_rounds=self.plan.collector_outage_rounds,
+            )
+            self.note(
+                "round %d: fleet collector DOWN for %d round(s) — "
+                "shipper must buffer and replay"
+                % (r, self.plan.collector_outage_rounds)
+            )
+        elif self._up_at is not None and r >= self._up_at:
+            self._up_at = None
+            self.collector.resume()
+            self.note(f"round {r}: fleet collector back up")
+
+    def finalize(self) -> Dict:
+        if self._up_at is not None:  # run ended while still down
+            self._up_at = None
+            self.collector.resume()
+        failures = self.shipper.push_failures_total
+        self.shipper.stop()  # final flush ships the buffered tail
+        st = self._host_state()
+        received = st.get("received_events", 0)
+        replayed = received - (self._received_at_pause or 0)
+        lost = st.get("lost_events", 0)
+        dropped = st.get("reported_dropped_total", 0)
+        survived = bool(
+            self.counters.get("collector_outage_injected")
+            and failures > 0  # the outage really made pushes fail
+            and lost == 0
+            and dropped == 0
+            and replayed > 0
+        )
+        if survived:
+            self.counters["collector_outage_survived"] = 1
+            self.note(
+                "collector outage survived: %d push failure(s) while "
+                "down, %d event(s) replayed after resume, 0 lost / 0 "
+                "dropped" % (failures, replayed)
+            )
+            _obs.instant(
+                "recovered", kind="collector_outage", replayed=replayed
+            )
+        self.summary = {
+            "push_failures": failures,
+            "events_replayed_after_resume": replayed,
+            "events_received": received,
+            "events_lost": lost,
+            "events_dropped": dropped,
+        }
+        return self.summary
+
+    def close(self) -> None:
+        from sparknet_tpu.obs import trace as _trace
+
+        if _trace._ship is self.shipper:
+            _obs.set_ship(self._prev_ship)
+        if self.shipper.alive:
+            self.shipper.stop()
+        self.collector.close()
 
 
 # ----------------------------------------------------------------------
@@ -713,12 +829,19 @@ def run_chaos(
                     "worker %d (skew %.2f) — straggler verdict exact"
                     % (r, w["worst_worker"], w["skew"])
                 )
+        if outage is not None:
+            outage.on_round_end(r)
 
     # the round profiler attributes the seeded straggler (installed for
     # the faulted run only; the baseline above ran unprofiled)
     profiler = None
     if plan.straggler_round is not None:
         profiler = _profile.install(_profile.RoundProfiler())
+    # collector_outage: fleet collector + shipper live for the faulted
+    # run only (the baseline ran unshipped)
+    outage = None
+    if plan.collector_outage_round is not None:
+        outage = _CollectorOutage(plan, counters, note)
     t_preempt = None
     try:
         with SignalHandler(
@@ -810,6 +933,11 @@ def run_chaos(
     finally:
         if profiler is not None:
             _profile.uninstall(profiler)
+        if outage is not None:
+            try:
+                outage.finalize()
+            finally:
+                outage.close()
 
     final_loss = final_round_loss(losses)
     if counters.get("dead_worker_injected") and np.isfinite(final_loss):
@@ -839,6 +967,9 @@ def run_chaos(
             "cache_corrupt_injected", "cache_corrupt_survived",
         ),
         "cache_cold": ("cache_cold_injected", "cache_cold_survived"),
+        "collector_outage": (
+            "collector_outage_injected", "collector_outage_survived",
+        ),
     }
     faults = {
         kind: {
@@ -868,6 +999,8 @@ def run_chaos(
         ),
         "cache_corrupt_round": plan.cache_corrupt_round,
         "cache_cold_round": plan.cache_cold_round,
+        "collector_outage_round": plan.collector_outage_round,
+        "collector_outage": outage.summary if outage is not None else None,
         # the faulted run's own cache traffic (baseline-leg reads on the
         # shared cache subtracted out)
         "cache_stats": {

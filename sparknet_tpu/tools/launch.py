@@ -76,7 +76,31 @@ def run_app(app: str, app_argv, coordinator, num_processes, process_id) -> int:
 def spawn_local(args, app_argv) -> int:
     """The CI/dev path: N OS processes on this machine, each given
     ``devices_per_host`` virtual CPU devices — process boundaries stand in
-    for host boundaries exactly as in tests/test_multihost.py."""
+    for host boundaries exactly as in tests/test_multihost.py.
+
+    With ``--fleet_collector`` the launcher starts the fleet collector
+    (obs/fleet.py) and points every simulated host's shipper at it
+    (``--ship_to`` appended to each app argv), so the whole run has ONE
+    merged /fleet + /metrics view and the end-of-run summary names any
+    late/dead host."""
+    collector = None
+    if args.fleet_collector:
+        from sparknet_tpu.obs.fleet import FleetCollector, parse_hostport
+
+        chost, cport = parse_hostport(args.fleet_collector)
+        collector = FleetCollector(host=chost, port=cport).start()
+        print(f"launch: fleet collector on {collector.url}/fleet")
+        app_argv = list(app_argv) + [f"--ship_to={collector.url}"]
+    try:
+        return _spawn_local_procs(args, app_argv, collector)
+    finally:
+        # the listener thread + bound port must not outlive a failed
+        # spawn/wait (Ctrl-C, bad app argv, a worker that never exits)
+        if collector is not None:
+            collector.close()
+
+
+def _spawn_local_procs(args, app_argv, collector) -> int:
     port = free_port()
     repo = os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -107,9 +131,13 @@ def spawn_local(args, app_argv) -> int:
             args.app,
             *app_argv,
         ]
+        env = env_base
+        if collector is not None:
+            # each simulated host gets a stable fleet identity
+            env = {**env_base, "SPARKNET_HOST_ID": f"host{pid}"}
         p = subprocess.Popen(
             cmd,
-            env=env_base,
+            env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
@@ -146,6 +174,23 @@ def spawn_local(args, app_argv) -> int:
         )
         if p.returncode != 0:
             rc = rc or p.returncode or 1
+    if collector is not None:
+        view = collector.fleet_view()
+        f = view["fleet"]
+        print(
+            "launch: fleet summary — %d host(s): %d live, %d late, "
+            "%d dead; round skew %s"
+            % (
+                f["hosts_total"], f["hosts_live"], f["hosts_late"],
+                f["hosts_dead"], f["round_skew"],
+            )
+        )
+        for h, st in sorted(view["hosts"].items()):
+            if st["state"] != "live":
+                print(
+                    "launch:   %s is %s (round %s, last seen %.1fs ago)"
+                    % (h, st["state"], st["round"], st["age_s"])
+                )
     return rc
 
 
@@ -172,6 +217,15 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--devices_per_host", type=int, default=2,
         help="virtual CPU devices per simulated host (simulation mode)",
+    )
+    parser.add_argument(
+        "--fleet_collector", nargs="?", default=None,
+        const="127.0.0.1:0", metavar="HOST:PORT",
+        help="simulation mode: start the fleet collector (obs/fleet.py) "
+        "in the launcher and ship every simulated host's telemetry to "
+        "it (appends --ship_to to each app argv); prints the merged "
+        "live/late/dead summary at the end.  Real clusters pass the "
+        "apps' own --fleet_collector/--ship_to flags instead",
     )
     parser.add_argument(
         "--coordinator", default=None, help="host:port of process 0"

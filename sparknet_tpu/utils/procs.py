@@ -14,7 +14,7 @@ import sys
 import tempfile
 import threading
 import time
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 # The canonical 2-process averaging worker: joins via jax.distributed,
 # builds one global dp=4 mesh, runs a real ParameterAveragingTrainer
@@ -40,6 +40,20 @@ initialize_distributed(
 assert jax.process_count() == 2, jax.process_count()
 assert jax.device_count() == 4, jax.device_count()
 assert jax.local_device_count() == 2
+
+# fleet-plane wiring: with SPARKNET_SHIP_TO set (the dryrun's fleet
+# leg) each process ships metric deltas + round spans to one collector
+import os as _os
+
+_run_obs = None
+if _os.environ.get("SPARKNET_SHIP_TO"):
+    from sparknet_tpu import obs as _obs
+
+    _run_obs = _obs.start(
+        ship_to=_os.environ["SPARKNET_SHIP_TO"],
+        host_id=_os.environ.get("SPARKNET_HOST_ID", f"proc{pid}"),
+        echo=None,
+    )
 
 NET = '''
 name: "toy"
@@ -80,6 +94,8 @@ for key, blobs in state.params.items():
     for blob in blobs:
         shards = [np.asarray(s.data) for s in blob.addressable_shards]
         np.testing.assert_allclose(shards[0], shards[1], rtol=1e-6)
+if _run_obs is not None:
+    _run_obs.close()  # final flush ships the run's tail
 print(f"@MARKER@ p{pid} smoothed={solver.smoothed_loss:.4f}")
 """
 
@@ -110,6 +126,21 @@ from sparknet_tpu.solver import Solver
 initialize_distributed(
     coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
 )
+
+# fleet-plane wiring: with SPARKNET_SHIP_TO set (tools/launch.py
+# --fleet_collector, or the e2e fleet test) each worker ships its
+# metric deltas + round spans to the one collector
+import os as _os
+
+_run_obs = None
+if _os.environ.get("SPARKNET_SHIP_TO"):
+    from sparknet_tpu import obs as _obs
+
+    _run_obs = _obs.start(
+        ship_to=_os.environ["SPARKNET_SHIP_TO"],
+        host_id=_os.environ.get("SPARKNET_HOST_ID", f"proc{pid}"),
+        echo=None,
+    )
 
 NET = '''
 name: "timed"
@@ -169,6 +200,8 @@ def timed(average_params):
 avg = timed(True)
 local = timed(False)
 coll_ms = max(0.0, (avg - local) * 1e3)
+if _run_obs is not None:
+    _run_obs.close()  # final flush ships the run's tail
 print(
     f"@MARKER@ p{pid} avg_ms={avg * 1e3:.3f} local_ms={local * 1e3:.3f} "
     f"collective_ms={coll_ms:.3f} tau={TAU}"
@@ -180,16 +213,99 @@ def timed_averaging_worker(marker: str) -> str:
     return _TIMED_AVERAGING_WORKER.replace("@MARKER@", marker)
 
 
+# Fleet-shipping worker: a real single-device training loop (tiny
+# InnerProduct net, per-round ``execute`` spans carrying the absolute
+# round) that ships its metric deltas + run-log events to the collector
+# named by SPARKNET_SHIP_TO — the per-process half of the fleet e2e
+# proof (tests/test_fleet.py) and of ``bench.py --mode=fleet``.  Env
+# knobs (all optional) shape the fleet scenario WITHOUT touching the
+# harness: SPARKNET_FLEET_ROUNDS / _ROUND_S (clock-paced rounds),
+# _STRAGGLE_FROM + _STRAGGLE_S (a slow host: extra per-round sleep from
+# an absolute round on), _LINGER_S (keep the shipper heartbeating after
+# the loop so a peer's lag verdict can be observed against a live
+# fleet), SPARKNET_SHIP_CLOCK_SKEW_S (a skewed host clock the
+# collector's alignment must recover).  Needs no cross-process
+# collectives, so it runs on any CPU jax build.
+_FLEET_SHIP_WORKER = r"""
+import os
+import sys
+import time
+
+import numpy as np
+
+pid = int(sys.argv[1])
+
+from sparknet_tpu import config, obs
+from sparknet_tpu.solver import Solver
+
+NET = '''
+name: "fleet_toy"
+layer { name: "data" type: "HostData" top: "x" top: "label"
+  java_data_param { shape { dim: 4 dim: 6 } shape { dim: 4 } } }
+layer { name: "ip1" type: "InnerProduct" bottom: "x" top: "logits"
+  inner_product_param { num_output: 3 weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "logits"
+  bottom: "label" top: "loss" }
+'''
+
+rounds = int(os.environ.get("SPARKNET_FLEET_ROUNDS", "5"))
+round_s = float(os.environ.get("SPARKNET_FLEET_ROUND_S", "0.02"))
+straggle_from = int(os.environ.get("SPARKNET_FLEET_STRAGGLE_FROM", "-1"))
+straggle_s = float(os.environ.get("SPARKNET_FLEET_STRAGGLE_S", "0"))
+linger_s = float(os.environ.get("SPARKNET_FLEET_LINGER_S", "0"))
+
+run = obs.start(
+    ship_to=os.environ["SPARKNET_SHIP_TO"],
+    host_id=os.environ.get("SPARKNET_HOST_ID", f"host{pid}"),
+    echo=None,
+)
+sp = config.parse_solver_prototxt(
+    'base_lr: 0.05 lr_policy: "fixed" momentum: 0.9'
+)
+solver = Solver(sp, net_param=config.parse_net_prototxt(NET))
+state = solver.init_state(seed=pid)
+rng = np.random.RandomState(pid)
+
+
+def window():
+    return {
+        "x": rng.randn(1, 4, 6).astype(np.float32),
+        "label": rng.randint(0, 3, (1, 4)).astype(np.float32),
+    }
+
+
+for r in range(rounds):
+    with obs.span("execute", round=r):
+        state, losses = solver.step(state, window())
+    run.shipper.note_round(r)
+    time.sleep(round_s + (straggle_s if 0 <= straggle_from <= r else 0.0))
+print(f"@MARKER@ p{pid} rounds={rounds} loss={solver.smoothed_loss:.4f}")
+sys.stdout.flush()
+if linger_s:
+    # loop done; keep the shipper heartbeating (a finished-but-alive
+    # host) until the harness kills us or the linger expires
+    time.sleep(linger_s)
+run.close()
+"""
+
+
+def fleet_ship_worker(marker: str) -> str:
+    return _FLEET_SHIP_WORKER.replace("@MARKER@", marker)
+
+
 def run_two_process_round(
     worker_src: str,
     marker: str,
     repo_root: str,
     devices_per_process: int = 2,
     timeout: int = 600,
+    env_extra: Optional[Dict[str, str]] = None,
 ) -> List[str]:
     """Spawn two workers running ``worker_src`` (argv: pid, port) on
     forced-CPU virtual devices; assert both exit 0 and print
-    ``<marker> p<pid>``; return the outputs.
+    ``<marker> p<pid>``; return the outputs.  ``env_extra`` merges into
+    each worker's environment (e.g. ``SPARKNET_SHIP_TO`` pointing both
+    at one fleet collector).
 
     Each worker is reaped on its own thread (so a fast-failing peer's
     output surfaces immediately and pipes never fill); on timeout the
@@ -213,6 +329,7 @@ def run_two_process_round(
                 f"--xla_force_host_platform_device_count="
                 f"{devices_per_process}"
             ),
+            **(env_extra or {}),
         }
         procs = [
             subprocess.Popen(

@@ -120,6 +120,7 @@ def test_unknown_mode_rejected():
     assert "profile" in out.stderr  # ... and the round-anatomy mode
     assert "datacache" in out.stderr  # ... and the data-plane cache mode
     assert "sanitize" in out.stderr  # ... and the invariant-sanitizer mode
+    assert "fleet" in out.stderr  # ... and the fleet-observability mode
     # env-var route rejects identically
     out = subprocess.run(
         [sys.executable, os.path.join(_REPO, "bench.py")],
@@ -505,19 +506,21 @@ _CHAOS_SCHEMA_KEYS = (
     "faults_survived", "faults", "recovery_latency_s", "resumed_from_iter",
     "quarantined", "final_loss", "baseline_final_loss", "loss_band",
     "loss_band_ok", "final_iter", "seed", "workers", "rounds", "tau",
-    "cache_stats",
+    "cache_stats", "collector_outage",
 )
 
 
 def test_committed_chaos_artifact_schema():
-    """CHAOS_r12.json — the fault-tolerance committed artifact: every
+    """CHAOS_r14.json — the fault-tolerance committed artifact: every
     injected fault survived (the ISSUE 2 done-bar), every fault CLASS
     fired — including the round-12 data-plane faults (cache entry
     corrupted -> quarantined + refetched; cache wiped cold ->
-    refilled) — the run resumed from an OLDER verified snapshot after
-    the newest was corrupted+quarantined, and the final loss sat inside
-    the no-fault run's band."""
-    with open(os.path.join(_REPO, "CHAOS_r12.json")) as f:
+    refilled) and the round-14 fleet-plane collector outage (pushes
+    failed while down, buffered events replayed with 0 lost) — the run
+    resumed from an OLDER verified snapshot after the newest was
+    corrupted+quarantined, and the final loss sat inside the no-fault
+    run's band."""
+    with open(os.path.join(_REPO, "CHAOS_r14.json")) as f:
         d = json.load(f)
     for key in _CHAOS_SCHEMA_KEYS:
         assert key in d, key
@@ -529,11 +532,15 @@ def test_committed_chaos_artifact_schema():
     for kind in (
         "storage", "stall", "preemption", "snapshot_corruption",
         "dead_worker", "nan_injection", "straggler_injection",
-        "cache_corruption", "cache_cold",
+        "cache_corruption", "cache_cold", "collector_outage",
     ):
         v = d["faults"][kind]
         assert v["injected"] >= 1, kind
         assert v["survived"] == v["injected"], (kind, v)
+    out = d["collector_outage"]
+    assert out["push_failures"] > 0
+    assert out["events_lost"] == 0 and out["events_dropped"] == 0
+    assert out["events_replayed_after_resume"] > 0
     assert d["recovery_latency_s"] > 0
     assert d["resumed_from_iter"] < d["final_iter"]
     assert d["quarantined"] and all(
@@ -545,6 +552,93 @@ def test_committed_chaos_artifact_schema():
     # was quarantined and the cold wipe forced refetches
     assert d["cache_stats"]["quarantined"] >= 1
     assert d["cache_stats"]["hits"] > 0 and d["cache_stats"]["misses"] > 0
+
+
+@pytest.mark.slow
+def test_fleet_mode_smoke():
+    """bench.py --mode=fleet end to end in a subprocess: overhead A/B,
+    the real 2-process fleet with exact straggler/dead attribution,
+    recovered clock skews, and the zero-loss outage replay."""
+    rec = _run_bench({
+        "BENCH_MODE": "fleet", "BENCH_ROUNDS": "2", "BENCH_PASSES": "1",
+    })
+    assert rec["metric"] == "fleet_ship_overhead_pct"
+    assert rec["hosts"] == 2
+    assert rec["straggler_attributed"] is True
+    assert rec["straggler_named_host"] == rec["straggler_seeded_host"]
+    assert rec["dead_detection_exact"] is True
+    assert rec["dead_detected_round"] == rec["dead_seeded_round"]
+    assert rec["clock_offset_bounded"] is True
+    assert rec["trace_interleaves_after_correction"] is True
+    assert rec["overhead_lost_events"] == 0
+    assert rec["outage_lost_events"] == 0
+    assert rec["outage_dropped_events"] == 0
+    assert rec["outage_replayed_events"] > 0
+    # the overhead itself is noise-bounded on a live CI box — the
+    # committed-artifact pin below enforces the <2% acceptance
+    assert rec["value"] < 25.0, rec
+
+
+_FLEET_SCHEMA_KEYS = (
+    "metric", "value", "unit", "vs_baseline", "platform", "workers",
+    "tau", "batch", "rounds", "passes", "baseline_round_ms",
+    "shipped_round_ms", "overhead_shipped_pct",
+    "overhead_events_shipped", "overhead_pushes", "overhead_lost_events",
+    "hosts", "fleet_rounds", "straggler_seeded_host",
+    "straggler_named_host", "straggler_attributed",
+    "dead_seeded_host", "dead_seeded_round", "dead_detected",
+    "dead_detected_round", "dead_detection_exact",
+    "clock_skew_injected_s", "clock_offset_est_s", "clock_offset_err_s",
+    "clock_offset_bounded", "trace_raw_overlap_s",
+    "trace_aligned_overlap_s", "trace_interleaves_after_correction",
+    "outage_down_s", "outage_push_failures", "outage_buffered_peak",
+    "outage_replayed_events", "outage_lost_events",
+    "outage_dropped_events", "note",
+)
+
+
+def test_committed_fleet_artifact_schema():
+    """FLEET_r14.json — the fleet observability plane committed
+    artifact (ISSUE 11 done-bars): shipper overhead inside the <2%
+    acceptance, the seeded dead host and seeded cross-host straggler
+    attributed at EXACTLY the injected round/host, the injected clock
+    skews recovered within the bound (merged trace interleaves only
+    after correction), and the collector-outage leg replayed with 0
+    lost events."""
+    with open(os.path.join(_REPO, "FLEET_r14.json")) as f:
+        d = json.load(f)
+    for key in _FLEET_SCHEMA_KEYS:
+        assert key in d, key
+    assert d["metric"] == "fleet_ship_overhead_pct"
+    assert d["value"] == d["overhead_shipped_pct"] < 2.0
+    # vs_baseline derives from the ROUNDED value (the PR-7 emitter
+    # convention): <= 1.0 means inside the 2% acceptance budget
+    assert d["vs_baseline"] == round(d["value"] / 2.0, 3) <= 1.0
+    assert d["hosts"] == 2
+    # overhead leg shipped real traffic, losslessly
+    assert d["overhead_events_shipped"] > 0 and d["overhead_pushes"] > 0
+    assert d["overhead_lost_events"] == 0
+    # exact cross-host straggler attribution
+    assert d["straggler_attributed"] is True
+    assert d["straggler_named_host"] == d["straggler_seeded_host"]
+    # exact dead-host attribution: right host, heartbeat pinned at the
+    # seeded final round
+    assert d["dead_detection_exact"] is True
+    assert d["dead_detected_round"] == d["dead_seeded_round"]
+    # clock alignment: both injected skews recovered within the bound,
+    # and the merged trace interleaves ONLY after correction
+    assert d["clock_offset_bounded"] is True
+    assert d["clock_offset_err_s"] < 0.5
+    assert set(d["clock_offset_est_s"]) == set(d["clock_skew_injected_s"])
+    assert d["trace_raw_overlap_s"] < 0 < d["trace_aligned_overlap_s"]
+    assert d["trace_interleaves_after_correction"] is True
+    # outage: pushes really failed, the buffer replayed, nothing lost
+    assert d["outage_push_failures"] > 0
+    assert d["outage_replayed_events"] > 0
+    assert d["outage_lost_events"] == 0
+    assert d["outage_dropped_events"] == 0
+    # honest noise disclosure rides in the note
+    assert "noise" in d["note"]
 
 
 @pytest.mark.slow

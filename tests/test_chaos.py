@@ -5,7 +5,7 @@ preemption with simulated process death, newest-snapshot corruption
 quarantined + fallback restore, and a dead dp worker masked out of the
 average — and requires every injected fault survived plus a final loss
 inside the no-fault baseline's band (the acceptance bar for
-``CHAOS_r12.json``)."""
+``CHAOS_r14.json``)."""
 
 import dataclasses
 import os

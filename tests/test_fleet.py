@@ -1,0 +1,663 @@
+"""Fleet observability plane (``obs/ship.py`` + ``obs/fleet.py``):
+metric snapshot/delta semantics, the per-host shipper's degradation
+contract, the collector's monotonic merge / clock alignment /
+liveness attribution, the merged multi-host report folding, the chaos
+``collector_outage`` fault, and the 2-real-process e2e."""
+
+import dataclasses
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from sparknet_tpu import obs
+from sparknet_tpu.obs.fleet import FleetCollector
+from sparknet_tpu.obs.metrics import MetricsRegistry, counter_deltas
+from sparknet_tpu.obs.ship import Shipper
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state():
+    """Fleet tests flip process-wide obs globals (training metrics, the
+    trace layer's ship hook) — start and end clean."""
+    obs.uninstall_tracer()
+    obs._reset_training_metrics_for_tests()
+    yield
+    obs._reset_training_metrics_for_tests()
+
+
+def _wait(predicate, timeout_s=10.0, interval_s=0.02):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return predicate()
+
+
+# ---------------------------------------------------------------------------
+# snapshot/delta API (obs/metrics.py)
+
+
+def test_snapshot_splits_counters_and_gauges():
+    reg = MetricsRegistry()
+    c = reg.counter("jobs_total")
+    g = reg.gauge("depth")
+    h = reg.histogram("lat", buckets=(0.1, 1.0))
+    c.inc(3)
+    g.set(7)
+    h.observe(0.05)
+    snap = reg.snapshot()
+    assert snap["counters"]["jobs_total"] == 3.0
+    assert snap["gauges"]["depth"] == 7.0
+    # histogram samples are cumulative -> counter semantics
+    assert snap["counters"]['lat_bucket{le="0.1"}'] == 1.0
+    assert snap["counters"]["lat_count"] == 1.0
+    assert "lat_count" not in snap["gauges"]
+
+
+def test_counter_delta_since_last_snapshot():
+    reg = MetricsRegistry()
+    c = reg.counter("jobs_total")
+    c.inc(5)
+    prev = reg.snapshot()["counters"]
+    c.inc(2)
+    deltas, resets = counter_deltas(prev, reg.snapshot()["counters"])
+    assert deltas == {"jobs_total": 2.0}
+    assert resets == []
+    # no movement -> empty payload, not a zero for every name
+    deltas, resets = counter_deltas(
+        reg.snapshot()["counters"], reg.snapshot()["counters"]
+    )
+    assert deltas == {} and resets == []
+
+
+def test_counter_reset_detection():
+    """A counter that DROPPED restarted from zero: the new value is the
+    delta and the sample is named in resets — history never un-counts."""
+    deltas, resets = counter_deltas(
+        {"jobs_total": 100.0}, {"jobs_total": 4.0}
+    )
+    assert deltas == {"jobs_total": 4.0}
+    assert resets == ["jobs_total"]
+
+
+def test_label_families_preserved_across_snapshots():
+    reg = MetricsRegistry()
+    fam = reg.counter("ops_total", labels=("kind",))
+    fam.labels("read").inc(2)
+    prev = reg.snapshot()["counters"]
+    fam.labels("read").inc()
+    fam.labels("write").inc(4)
+    deltas, _ = counter_deltas(prev, reg.snapshot()["counters"])
+    assert deltas == {
+        'ops_total{kind="read"}': 1.0,
+        'ops_total{kind="write"}': 4.0,
+    }
+
+
+# ---------------------------------------------------------------------------
+# shipper degradation contract
+
+
+def test_shipper_buffers_and_drops_oldest_when_unreachable():
+    """No collector listening: record_event never blocks, the buffer
+    stays bounded, the OLDEST events drop and are counted, and the ship
+    thread survives to retry."""
+    s = Shipper(
+        "http://127.0.0.1:9",  # discard port — nothing listens
+        host="h", interval_s=0.05, capacity=10,
+    )
+    s.start()
+    try:
+        for i in range(25):
+            s.record_event({"kind": "instant", "name": f"e{i}",
+                            "t_s": time.time(), "thread": "t"})
+        assert _wait(lambda: s.push_failures_total >= 1, timeout_s=15)
+        assert s.alive
+    finally:
+        s.stop()  # final flush fails too; the buffer settles
+    with s._lock:
+        buffered = list(s._buf)
+    assert len(buffered) <= 10
+    assert s.events_total == 25
+    assert s.dropped_total == 25 - len(buffered)
+    # drop-oldest: the newest record is still buffered
+    assert buffered[-1]["name"] == "e24"
+
+
+def test_shipper_own_thread_events_are_not_self_fed(monkeypatch):
+    """A record arriving on the ship thread itself is skipped — a
+    push's own spans must not feed the next push's payload forever."""
+    s = Shipper("http://127.0.0.1:9", host="h", interval_s=30)
+    rec = {"kind": "instant", "name": "x", "t_s": 0.0, "thread": "t"}
+    s.record_event(rec)
+    assert s.events_total == 1
+    monkeypatch.setattr(
+        "sparknet_tpu.obs.ship.threading.current_thread",
+        lambda: s._thread,
+    )
+    s.record_event(rec)
+    assert s.events_total == 1, "self-shipped event must be filtered"
+
+
+def test_shipper_round_heartbeat_from_span_args_and_note_round():
+    s = Shipper("http://127.0.0.1:9", host="h", interval_s=30)
+    s.record_event({"kind": "span", "name": "execute", "t_s": 0.0,
+                    "thread": "t", "args": {"round": 4}})
+    assert s._max_round == 4
+    s.record_event({"kind": "span", "name": "execute", "t_s": 0.0,
+                    "thread": "t", "args": {"round": 2}})
+    assert s._max_round == 4  # monotonic
+    s.note_round(9)
+    assert s._max_round == 9
+
+
+# ---------------------------------------------------------------------------
+# collector merge
+
+
+def _push(host, seq, boot="b0", **kw):
+    payload = {
+        "host": host, "boot_id": boot, "seq": seq,
+        "t_send": time.time(), "counters": {}, "gauges": {},
+        "events": [], "events_total": 0, "dropped_total": 0,
+    }
+    payload.update(kw)
+    return payload
+
+
+def test_parse_hostport():
+    from sparknet_tpu.obs.fleet import DEFAULT_FLEET_PORT, parse_hostport
+
+    assert parse_hostport("127.0.0.1:0") == ("127.0.0.1", 0)
+    assert parse_hostport(":8400") == ("127.0.0.1", 8400)
+    assert parse_hostport("8400") == ("127.0.0.1", 8400)
+    assert parse_hostport("myhost") == ("myhost", DEFAULT_FLEET_PORT)
+    with pytest.raises(ValueError, match="HOST:PORT"):
+        parse_hostport("myhost:abc")
+
+
+def test_collector_merges_counter_deltas_per_host_and_fleet():
+    c = FleetCollector(port=0)
+    c.ingest(_push("a", 0, counters={"sparknet_rounds_total": 3}))
+    c.ingest(_push("b", 0, counters={"sparknet_rounds_total": 5}))
+    c.ingest(_push("a", 1, counters={"sparknet_rounds_total": 2}))
+    view = c.fleet_view()
+    assert view["hosts"]["a"]["counters"]["sparknet_rounds_total"] == 5
+    assert view["hosts"]["b"]["counters"]["sparknet_rounds_total"] == 5
+    assert view["fleet"]["counters"]["sparknet_rounds_total"] == 10
+
+
+def test_collector_survives_host_restart_monotonically():
+    """A restarted process (new boot id, fresh deltas) keeps the merged
+    total GROWING and is counted as a reset."""
+    c = FleetCollector(port=0)
+    c.ingest(_push("a", 0, counters={"sparknet_rounds_total": 7}))
+    c.ingest(_push("a", 0, boot="b1",
+                   counters={"sparknet_rounds_total": 2}))
+    view = c.fleet_view()
+    assert view["hosts"]["a"]["counters"]["sparknet_rounds_total"] == 9
+    assert view["hosts"]["a"]["restarts"] == 1
+    assert c.m_resets.labels("a").value == 1
+    # a NEGATIVE delta (unflagged reset / shipper bug) counts nothing —
+    # the post-reset value is unrecoverable from the delta, and the
+    # magnitude of the drop must not inflate the total
+    c.ingest(_push("a", 1, boot="b1",
+                   counters={"sparknet_rounds_total": -95}))
+    view = c.fleet_view()
+    assert view["hosts"]["a"]["counters"]["sparknet_rounds_total"] == 9
+    assert view["hosts"]["a"]["restarts"] == 2
+
+
+def test_collector_liveness_late_and_dead_attribution():
+    c = FleetCollector(port=0, dead_after_s=0.3, late_round_lag=2)
+    c.ingest(_push("fast", 0, round=10))
+    c.ingest(_push("slow", 0, round=6))
+    view = c.fleet_view()
+    assert view["hosts"]["fast"]["state"] == "live"
+    assert view["hosts"]["slow"]["state"] == "late"
+    assert view["fleet"]["round_skew"] == 4
+    # a lag within threshold is still live
+    c.ingest(_push("slow", 1, round=9))
+    assert c.fleet_view()["hosts"]["slow"]["state"] == "live"
+    # a silent host misses its deadline -> dead (and leaves the median)
+    time.sleep(0.35)
+    c.ingest(_push("fast", 1, round=11))
+    view = c.fleet_view()
+    assert view["hosts"]["slow"]["state"] == "dead"
+    assert view["hosts"]["fast"]["state"] == "live"
+    # dead hosts keep their last round heartbeat — the detection anchor
+    assert view["hosts"]["slow"]["round"] == 9
+    text = c.render_metrics()
+    assert 'sparknet_fleet_hosts{state="dead"} 1' in text
+    assert 'sparknet_fleet_hosts{state="live"} 1' in text
+    assert 'sparknet_fleet_round{host="fast"} 11' in text
+
+
+def test_collector_clock_offset_one_way_filter():
+    """Each sample is offset - network_delay; delay only ever
+    SUBTRACTS, so the largest sample converges on the true offset."""
+    c = FleetCollector(port=0)
+    t0 = time.time()
+    # host clock runs 100s ahead; delays 0.5 then 0.02 then 0.2
+    c.ingest(_push("a", 0, t_send=t0 + 100.0), t_recv=t0 + 0.5)
+    c.ingest(_push("a", 1, t_send=t0 + 100.0), t_recv=t0 + 0.02)
+    c.ingest(_push("a", 2, t_send=t0 + 100.0), t_recv=t0 + 0.2)
+    off = c.fleet_view()["hosts"]["a"]["clock_offset_s"]
+    assert off == pytest.approx(99.98, abs=1e-6)
+
+
+def test_collector_lost_event_accounting():
+    """events_total - dropped - received = lost: a push that vanished
+    entirely shows up as lost events, not silence."""
+    c = FleetCollector(port=0)
+    ev = [{"kind": "instant", "name": "x", "t_s": time.time(),
+           "thread": "t"}]
+    c.ingest(_push("a", 0, events=ev, events_total=1, dropped_total=0))
+    assert c.fleet_view()["hosts"]["a"]["lost_events"] == 0
+    # the shipper enqueued 5 by now but only 1 arrived; 2 were dropped
+    # at its bound -> 2 lost
+    c.ingest(_push("a", 2, events=ev, events_total=5, dropped_total=2))
+    st = c.fleet_view()["hosts"]["a"]
+    assert st["received_events"] == 2
+    assert st["lost_events"] == 1
+    assert st["lost_pushes"] == 1  # seq 1 never arrived
+
+
+def test_collector_merged_trace_clock_aligned():
+    """Two hosts with wildly skewed clocks recording the SAME wall-time
+    window: raw t_s ranges are disjoint, the merged trace interleaves
+    after the per-host offset correction, one process lane per host."""
+    c = FleetCollector(port=0)
+    t0 = time.time()
+    skew_a, skew_b = 1000.0, -500.0
+
+    def spans(skew, host, seq):
+        evs = [{
+            "kind": "span", "name": "execute", "cat": "phase",
+            "t_s": t0 + 0.1 * i + skew, "dur_ms": 80.0,
+            "thread": "MainThread", "args": {"round": i},
+        } for i in range(3)]
+        return _push(host, seq, t_send=t0 + skew, events=evs,
+                     events_total=3, dropped_total=0)
+
+    c.ingest(spans(skew_a, "a", 0), t_recv=t0 + 0.001)
+    c.ingest(spans(skew_b, "b", 0), t_recv=t0 + 0.001)
+    doc = c.merged_trace()
+    procs = {
+        e["args"]["name"]: e["pid"] for e in doc["traceEvents"]
+        if e["name"] == "process_name"
+    }
+    assert set(procs) == {"a", "b"}
+    by_pid = {}
+    for e in doc["traceEvents"]:
+        if e.get("ph") == "X":
+            by_pid.setdefault(e["pid"], []).append(e)
+    (sa, sb) = by_pid[procs["a"]], by_pid[procs["b"]]
+    lo_a, hi_a = min(e["ts"] for e in sa), max(e["ts"] for e in sa)
+    lo_b, hi_b = min(e["ts"] for e in sb), max(e["ts"] for e in sb)
+    # corrected timelines overlap (same real window) even though the
+    # raw clocks were 1500s apart
+    assert min(hi_a, hi_b) > max(lo_a, lo_b)
+    # spans carry their host in args
+    assert all(e["args"]["host"] == "a" for e in sa)
+    # exact placement: t_s IS the span START (the ship hook's stamp) —
+    # spans land at 0/100/200 ms on the corrected timeline, not shifted
+    # a duration early (regression: merged_trace double-subtracted dur)
+    for i, e in enumerate(sorted(sa, key=lambda e: e["ts"])):
+        assert e["ts"] == pytest.approx(i * 100_000, abs=500), (i, e)
+        assert e["dur"] == pytest.approx(80_000, abs=1)
+
+
+def test_collector_http_endpoints_and_pause_resume():
+    c = FleetCollector(port=0).start()
+    try:
+        url = c.url
+        body = json.dumps(_push(
+            "h", 0, round=3,
+            counters={"sparknet_rounds_total": 3},
+            events=[{"kind": "instant", "name": "tick",
+                     "t_s": time.time(), "thread": "t"}],
+            events_total=1,
+        )).encode()
+        req = urllib.request.Request(
+            url + "/push", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as rsp:
+            assert json.load(rsp)["ok"] is True
+        view = json.load(urllib.request.urlopen(url + "/fleet", timeout=5))
+        assert view["hosts"]["h"]["round"] == 3
+        text = urllib.request.urlopen(
+            url + "/metrics", timeout=5).read().decode()
+        assert 'sparknet_rounds_total{host="h"} 3' in text
+        assert 'sparknet_rounds_total{host="fleet"} 3' in text
+        runlog = urllib.request.urlopen(
+            url + "/runlog", timeout=5).read().decode()
+        rec = json.loads(runlog.strip().splitlines()[0])
+        assert rec["host"] == "h" and rec["name"] == "tick"
+        trace = json.load(urllib.request.urlopen(url + "/trace", timeout=5))
+        assert trace["otherData"]["clock_aligned"] is True
+        # pause tears the listener down; resume rebinds the SAME port
+        c.pause()
+        with pytest.raises(Exception):
+            urllib.request.urlopen(url + "/fleet", timeout=0.5)
+        c.resume()
+        assert c.url == url
+        view = json.load(urllib.request.urlopen(url + "/fleet", timeout=5))
+        assert view["hosts"]["h"]["round"] == 3  # state survived
+    finally:
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# shipper -> collector over real HTTP (in-process integration)
+
+
+def test_ship_end_to_end_metrics_and_events():
+    c = FleetCollector(port=0).start()
+    try:
+        run = obs.start(ship_to=c.url, host_id="hostA", echo=None)
+        assert run.shipper is not None
+        run.shipper.interval_s = 0.05
+        tm = obs.training_metrics()
+        tm.rounds.inc(4)
+        tm.faults.labels("stall").inc()
+        for r in range(3):
+            with obs.span("execute", round=r):
+                pass
+        assert _wait(lambda: c.fleet_view()["hosts"].get(
+            "hostA", {}).get("received_events", 0) >= 3)
+        run.close()  # final flush
+        st = c.fleet_view()["hosts"]["hostA"]
+        assert st["counters"]["sparknet_rounds_total"] == 4.0
+        assert st["counters"]['sparknet_faults_total{kind="stall"}'] == 1.0
+        assert st["round"] == 2
+        assert st["lost_events"] == 0
+        # shipper's own series rode along (label-free canon names)
+        assert st["counters"]["sparknet_ship_pushes_total"] >= 1
+        # offset vs the same machine's clock is ~0 (loopback delay)
+        assert abs(st["clock_offset_s"]) < 1.0
+    finally:
+        c.close()
+
+
+def test_obs_start_fleet_collector_self_ship_and_close():
+    """--fleet_collector alone: the process ships to its own collector;
+    close() stops the shipper before the collector (tail lands)."""
+    run = obs.start(fleet_collector="127.0.0.1:0", echo=None)
+    assert run.collector is not None and run.shipper is not None
+    run.shipper.interval_s = 0.05
+    collector = run.collector
+    with obs.span("execute", round=0):
+        pass
+    run.close()
+    host = run.shipper.host
+    st = collector.fleet_view()["hosts"][host]
+    assert st["received_events"] >= 1 and st["lost_events"] == 0
+    assert not run.shipper.alive
+
+
+def test_backlog_larger_than_one_batch_never_reads_as_loss():
+    """A burst bigger than max_batch drains over several pushes; the
+    still-buffered tail must not be reported as lost in between (the
+    monotonic fleet lost counter would never come back down)."""
+    c = FleetCollector(port=0).start()
+    s = Shipper(c.url, host="bh", interval_s=0.03, max_batch=10)
+    s.start()
+    try:
+        for i in range(35):
+            s.record_event({"kind": "instant", "name": f"b{i}",
+                            "t_s": time.time(), "thread": "t"})
+        assert _wait(lambda: c.fleet_view()["hosts"].get("bh", {}).get(
+            "received_events", 0) >= 35, timeout_s=20)
+        s.stop()
+        st = c.fleet_view()["hosts"]["bh"]
+        assert st["received_events"] == 35
+        assert st["lost_events"] == 0
+        # the monotonic counter never spiked either
+        assert c.m_lost.labels("bh").value == 0
+    finally:
+        if s.alive:
+            s.stop()
+        c.close()
+
+
+def test_chaos_outage_restores_previous_ship_hook():
+    """A surrounding --ship_to run's shipper must come back after the
+    chaos-local collector/shipper tear down."""
+    from sparknet_tpu.obs import trace as _trace
+    from sparknet_tpu.runtime import chaos
+
+    class _Sentinel:
+        def record_event(self, rec):
+            pass
+
+    prev = _Sentinel()
+    obs.set_ship(prev)
+    try:
+        outage = chaos._CollectorOutage(
+            dataclasses.replace(
+                chaos.FaultPlan.default(), collector_outage_round=0,
+                collector_outage_rounds=1,
+            ),
+            {}, lambda msg: None,
+        )
+        assert _trace._ship is outage.shipper
+        outage.close()
+        assert _trace._ship is prev
+    finally:
+        obs.set_ship(None)
+
+
+def test_shipper_outage_buffered_replay_zero_lost():
+    """The tentpole degradation proof, in-process: collector down ->
+    pushes fail, buffer holds; resume -> replay; 0 lost, 0 dropped."""
+    c = FleetCollector(port=0).start()
+    s = Shipper(c.url, host="oh", interval_s=0.03)
+    s.start()
+    try:
+        def tick(i):
+            s.record_event({"kind": "instant", "name": "tick",
+                            "t_s": time.time(), "thread": "t",
+                            "args": {"i": i}})
+
+        def received():
+            return c.fleet_view()["hosts"].get("oh", {}).get(
+                "received_events", 0)
+
+        for i in range(20):
+            tick(i)
+        assert _wait(lambda: received() >= 20)
+        c.pause()
+        for i in range(20, 50):
+            tick(i)
+        assert _wait(lambda: s.push_failures_total > 0, timeout_s=20)
+        c.resume()
+        assert _wait(lambda: received() >= 50, timeout_s=20)
+        s.stop()
+        st = c.fleet_view()["hosts"]["oh"]
+        assert st["received_events"] == 50
+        assert st["lost_events"] == 0
+        assert st["reported_dropped_total"] == 0
+    finally:
+        if s.alive:
+            s.stop()
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# merged multi-host report folding (tools/trace_report, health_report)
+
+
+def _load_tool(name):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_" + name, os.path.join(_REPO, "tools", name + ".py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_trace_report_folds_per_host_lanes(tmp_path):
+    """A merged 2-host run log: host lanes fold separately (no
+    cross-host thread collision), and a producer overlapped in time
+    ONLY by the OTHER host's execute counts as 0%% hidden."""
+    trace_report = _load_tool("trace_report")
+    lines = [
+        # host a: assemble on its producer thread 0..100ms, its OWN
+        # execute elsewhere in time (no overlap) -> hidden 0
+        {"kind": "span", "name": "assemble", "cat": "phase",
+         "ts_s": 0.0, "dur_ms": 100.0, "thread": "producer",
+         "host": "a", "args": {"round": 1}},
+        {"kind": "span", "name": "execute", "cat": "phase",
+         "ts_s": 0.2, "dur_ms": 100.0, "thread": "MainThread",
+         "host": "a", "args": {"round": 1}},
+        # host b: execute EXACTLY covering host a's assemble window —
+        # coincidence, not pipelining; must not count as hidden
+        {"kind": "span", "name": "execute", "cat": "phase",
+         "ts_s": 0.0, "dur_ms": 100.0, "thread": "MainThread",
+         "host": "b", "args": {"round": 1}},
+        # host b straggler verdict instant names its host
+        {"kind": "instant", "name": "profile", "cat": "profile",
+         "ts_s": 0.3, "thread": "MainThread", "host": "b",
+         "args": {"round": 1, "straggler": True, "worst_worker": 3,
+                  "skew": 2.5}},
+    ]
+    p = tmp_path / "merged.jsonl"
+    p.write_text("".join(json.dumps(l) + "\n" for l in lines))
+    rep = trace_report.fold(trace_report.load_events(str(p)))
+    assert rep["hosts"] == ["a", "b"]
+    assert rep["producer_hidden_fraction"] == 0.0
+    # the two hosts' MainThreads stay separate lanes
+    assert rep["phases"]["execute"]["count"] == 2
+    assert sorted(rep["phases"]["execute"]["threads"]) == [
+        "a/MainThread", "b/MainThread"
+    ]
+    assert rep["stragglers"] == [
+        {"host": "b", "round": 1, "worker": 3, "skew": 2.5}
+    ]
+    # same-host overlap still counts: move host a's execute under its
+    # assemble (different thread, same host)
+    lines[1]["ts_s"] = 0.0
+    p.write_text("".join(json.dumps(l) + "\n" for l in lines))
+    rep = trace_report.fold(trace_report.load_events(str(p)))
+    assert rep["producer_hidden_fraction"] == 1.0
+
+
+def test_health_report_names_host_in_poisoned_table(tmp_path):
+    health_report = _load_tool("health_report")
+    lines = [
+        {"kind": "instant", "name": "health", "ts_s": 0.1,
+         "thread": "MainThread", "host": "host0",
+         "args": {"round": 0, "ok": True, "loss": 1.0, "nonfinite": 0,
+                  "action": "none"}},
+        {"kind": "instant", "name": "health", "ts_s": 0.2,
+         "thread": "MainThread", "host": "host1",
+         "args": {"round": 1, "ok": False, "loss": float("nan"),
+                  "nonfinite": 3, "action": "warn",
+                  "masked_workers": [1]}},
+    ]
+    p = tmp_path / "merged.jsonl"
+    p.write_text("".join(
+        json.dumps(l, default=str) + "\n" for l in lines
+    ))
+    rep = health_report.fold(health_report.load_records(str(p)))
+    assert rep["hosts"] == ["host0", "host1"]
+    assert rep["first_poisoned_round"] == 1
+    assert rep["first_poisoned_host"] == "host1"
+    text = health_report.format_report(rep)
+    assert "host1" in text.splitlines()[-1]  # the headline names it
+
+
+# ---------------------------------------------------------------------------
+# chaos collector_outage fault
+
+
+@pytest.mark.chaos
+def test_chaos_collector_outage_buffered_replay():
+    """The collector_outage fault on a trimmed plan: the collector goes
+    down for one round mid-run, the shipper buffers and replays —
+    survived = pushes failed while down, 0 lost, 0 dropped."""
+    import jax
+
+    from sparknet_tpu.runtime import chaos
+
+    if jax.device_count() < 4:
+        pytest.skip("needs the 4-device virtual mesh (conftest)")
+    plan = dataclasses.replace(
+        chaos.FaultPlan.default(),
+        rounds=4, storage_faults=(), stall_rounds=(), preempt_round=None,
+        corrupt_newest=False, dead_worker=None, nan_round=None,
+        straggler_round=None, cache_corrupt_round=None,
+        cache_cold_round=None,
+        collector_outage_round=1, collector_outage_rounds=1,
+    )
+    rep = chaos.run_chaos(plan)
+    assert rep["faults"]["collector_outage"] == {
+        "injected": 1, "survived": 1,
+    }
+    out = rep["collector_outage"]
+    assert out["push_failures"] > 0
+    assert out["events_lost"] == 0 and out["events_dropped"] == 0
+    assert out["events_replayed_after_resume"] > 0
+
+
+# ---------------------------------------------------------------------------
+# e2e: two real processes shipping to one collector (tier-1, CPU-only)
+
+
+def test_two_processes_ship_to_one_collector_e2e():
+    """The fleet plane across REAL process boundaries: two worker
+    processes (tiny single-device training loops, utils/procs.py fleet
+    worker) ship metric deltas + round spans to one in-test collector;
+    the merged view must show both hosts live with their final rounds,
+    fleet counters summing both, and zero lost events."""
+    from sparknet_tpu.utils.procs import (
+        fleet_ship_worker,
+        run_two_process_round,
+    )
+
+    c = FleetCollector(port=0).start()
+    try:
+        run_two_process_round(
+            fleet_ship_worker("FLEET_E2E_OK"),
+            "FLEET_E2E_OK", _REPO, devices_per_process=1, timeout=300,
+            env_extra={
+                "SPARKNET_SHIP_TO": c.url,
+                "SPARKNET_SHIP_INTERVAL_S": "0.1",
+                "SPARKNET_FLEET_ROUNDS": "4",
+            },
+        )
+        view = c.fleet_view()
+        assert sorted(view["hosts"]) == ["host0", "host1"]
+        for h, st in view["hosts"].items():
+            assert st["round"] == 3, (h, st)
+            assert st["lost_events"] == 0, (h, st)
+            assert st["received_events"] >= 4, (h, st)
+            # real training shipped real series: 4 solver iterations
+            assert st["counters"]["sparknet_iters_total"] == 4.0, (h, st)
+        assert view["fleet"]["counters"]["sparknet_iters_total"] == 8.0
+        assert view["fleet"]["round_skew"] == 0
+        # the merged run log folds with per-host lanes
+        trace_report = _load_tool("trace_report")
+        import tempfile
+
+        with tempfile.NamedTemporaryFile(
+            "w", suffix=".jsonl", delete=False
+        ) as f:
+            f.write(c.merged_runlog())
+        rep = trace_report.fold(trace_report.load_events(f.name))
+        os.unlink(f.name)
+        assert rep["hosts"] == ["host0", "host1"]
+        assert rep["phases"]["execute"]["count"] >= 8
+    finally:
+        c.close()

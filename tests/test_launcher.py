@@ -85,6 +85,48 @@ def _run_launch(args):
     return out
 
 
+def test_spawn_local_fleet_collector_wires_ship_to(monkeypatch, capsys):
+    """--fleet_collector: the launcher starts the collector, appends
+    --ship_to=<its url> to every simulated host's app argv, gives each
+    host a stable SPARKNET_HOST_ID, and prints the end-of-run fleet
+    summary (no child processes actually spawned here)."""
+    import io
+    import types
+
+    from sparknet_tpu.tools import launch
+
+    spawned = []
+
+    class FakeProc:
+        returncode = 0
+
+        def __init__(self, cmd, env=None, **kw):
+            spawned.append((cmd, env))
+            self.stdout = io.StringIO("")
+
+        def wait(self, timeout=None):
+            return 0
+
+        def poll(self):
+            return 0
+
+    monkeypatch.setattr(launch.subprocess, "Popen", FakeProc)
+    args = types.SimpleNamespace(
+        nprocs=2, devices_per_host=1, app="cifar", timeout=5,
+        fleet_collector="127.0.0.1:0",
+    )
+    rc = launch.spawn_local(args, ["--rounds=1"])
+    assert rc == 0
+    assert len(spawned) == 2
+    for pid, (cmd, env) in enumerate(spawned):
+        ship = [a for a in cmd if a.startswith("--ship_to=")]
+        assert ship and ship[0].startswith("--ship_to=http://127.0.0.1:")
+        assert env["SPARKNET_HOST_ID"] == f"host{pid}"
+    out = capsys.readouterr().out
+    assert "fleet collector on" in out
+    assert "fleet summary" in out
+
+
 def test_provision_dry_run_emits_exact_sequence():
     out = _run_launch([
         "provision", "--dry-run", "--name=sparknet-v5e",

@@ -186,6 +186,50 @@ def test_sanitize_family_rules(tmp_path):
         ), (bad_field, rows)
 
 
+GOOD_FLEET = {
+    "overhead_shipped_pct": 0.4, "hosts": 2,
+    "straggler_attributed": True, "dead_detection_exact": True,
+    "clock_offset_bounded": True,
+    "trace_interleaves_after_correction": True,
+    "overhead_lost_events": 0, "outage_push_failures": 3,
+    "outage_replayed_events": 150, "outage_lost_events": 0,
+    "outage_dropped_events": 0,
+    "value": 0.4,
+}
+
+
+def test_fleet_family_rules(tmp_path):
+    """The FLEET family (ISSUE 11): shipper overhead < 2%, exact
+    dead/straggler attribution, bounded clock correction, and a
+    zero-loss outage replay — any one regressing fails --check."""
+    g = _gate()
+    _write(tmp_path, "FLEET_r14.json", GOOD_FLEET)
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 0, rows
+    for bad_field, bad_value in (
+        ("overhead_shipped_pct", 3.5),     # shipping cost out of band
+        ("straggler_attributed", False),   # wrong/no late host named
+        ("dead_detection_exact", False),   # wrong host or round
+        ("clock_offset_bounded", False),   # skew not recovered
+        ("trace_interleaves_after_correction", False),
+        ("overhead_lost_events", 2),       # lossy steady-state shipping
+        ("outage_push_failures", 0),       # vacuous: outage never bit
+        ("outage_replayed_events", 0),     # nothing buffered/replayed
+        ("outage_lost_events", 5),         # the replay lost events
+        ("outage_dropped_events", 1),      # buffer overflowed
+        ("hosts", 1),                      # not actually a fleet
+    ):
+        _write(
+            tmp_path, "FLEET_r15.json",
+            dict(GOOD_FLEET, **{bad_field: bad_value}),
+        )
+        rc, rows = g.check(str(tmp_path))
+        assert rc == 1, bad_field
+        assert any(
+            bad_field in r["detail"] for r in rows if not r["ok"]
+        ), (bad_field, rows)
+
+
 def test_missing_key_is_a_failure_not_a_pass(tmp_path):
     g = _gate()
     _write(tmp_path, "OBS_r09.json", {"overhead_traced_pct": 0.5})

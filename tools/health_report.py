@@ -33,7 +33,13 @@ def load_records(path: str) -> List[dict]:
                     continue
                 rec = json.loads(line)
                 if rec.get("kind") == "instant" and rec.get("name") == "health":
-                    records.append(rec.get("args", {}))
+                    args = rec.get("args", {})
+                    # merged multi-host bundles (the fleet collector's
+                    # /runlog) tag each line with its host: carry it so
+                    # the table names WHICH host's round went bad
+                    if rec.get("host") and "host" not in args:
+                        args = dict(args, host=rec["host"])
+                    records.append(args)
         return records
     with open(path) as f:
         doc = json.load(f)
@@ -50,6 +56,7 @@ def fold(records: List[dict]) -> Dict[str, object]:
         (r for r in records if "round" in r), key=lambda r: r["round"]
     )
     first_poisoned: Optional[int] = None
+    first_poisoned_host: Optional[str] = None
     anomalies = 0
     actions: Dict[str, int] = {}
     for r in rounds:
@@ -57,6 +64,7 @@ def fold(records: List[dict]) -> Dict[str, object]:
             anomalies += 1
             if first_poisoned is None and r.get("nonfinite", 0) > 0:
                 first_poisoned = int(r["round"])
+                first_poisoned_host = r.get("host")
         a = r.get("action", "none")
         if a != "none":
             actions[a] = actions.get(a, 0) + 1
@@ -66,46 +74,64 @@ def fold(records: List[dict]) -> Dict[str, object]:
         flagged = [r for r in rounds if not r.get("ok", True)]
         if flagged:
             first_poisoned = int(flagged[0]["round"])
+            first_poisoned_host = flagged[0].get("host")
+    hosts = sorted({str(r["host"]) for r in rounds if r.get("host")})
     return {
         "rounds_observed": len(rounds),
+        "hosts": hosts or None,
         "anomalies": anomalies,
         "first_poisoned_round": first_poisoned,
+        # which host's sentry flagged it (None for single-host logs —
+        # merged fleet bundles always name the host)
+        "first_poisoned_host": first_poisoned_host,
         "actions": actions,
         "rounds": rounds,
     }
 
 
 def format_report(rep: Dict[str, object]) -> str:
-    lines = [
-        "%-6s %10s %8s %10s %9s %-10s %-9s %s"
-        % ("round", "loss", "z", "grad_norm", "nonfinite", "masked",
-           "action", "reasons")
-    ]
+    multihost = bool(rep.get("hosts"))
+    header = ["round", "loss", "z", "grad_norm", "nonfinite", "masked",
+              "action", "reasons"]
+    fmt = "%-6s %10s %8s %10s %9s %-10s %-9s %s"
+    if multihost:
+        header.insert(1, "host")
+        fmt = "%-6s %-10s %10s %8s %10s %9s %-10s %-9s %s"
+    lines = [fmt % tuple(header)]
+    rowfmt = fmt.replace("%-6s", "%-6d", 1).replace(
+        "%10s %8s %10s %9s", "%10.4g %8.2f %10.4g %9d"
+    )
     for r in rep["rounds"]:
-        lines.append(
-            "%-6d %10.4g %8.2f %10.4g %9d %-10s %-9s %s"
-            % (
-                r.get("round", -1),
-                r.get("loss", float("nan")),
-                r.get("zscore", 0.0),
-                r.get("grad_norm", float("nan")),
-                r.get("nonfinite", 0),
-                ",".join(str(w) for w in r.get("masked_workers", [])) or "-",
-                r.get("action", "none"),
-                ",".join(r.get("reasons", [])) or "-",
-            )
-        )
+        row = [
+            r.get("round", -1),
+            r.get("loss", float("nan")),
+            r.get("zscore", 0.0),
+            r.get("grad_norm", float("nan")),
+            r.get("nonfinite", 0),
+            ",".join(str(w) for w in r.get("masked_workers", [])) or "-",
+            r.get("action", "none"),
+            ",".join(r.get("reasons", [])) or "-",
+        ]
+        if multihost:
+            row.insert(1, str(r.get("host", "-")))
+        lines.append(rowfmt % tuple(row))
     lines.append(
-        "rounds: %d | anomalies: %d | actions: %s"
+        "rounds: %d%s | anomalies: %d | actions: %s"
         % (
-            rep["rounds_observed"], rep["anomalies"],
+            rep["rounds_observed"],
+            " over hosts %s" % ",".join(rep["hosts"]) if multihost else "",
+            rep["anomalies"],
             rep["actions"] or "none",
         )
     )
     fp = rep["first_poisoned_round"]
+    fph = rep.get("first_poisoned_host")
     lines.append(
         "first poisoned round: %s"
-        % ("none — run healthy" if fp is None else fp)
+        % (
+            "none — run healthy" if fp is None
+            else ("%s on host %s" % (fp, fph) if fph else fp)
+        )
     )
     return "\n".join(lines)
 

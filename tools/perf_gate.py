@@ -147,6 +147,27 @@ RULES: Dict[str, List[Rule]] = {
         Rule("lint_new_findings", "==", 0),
         Rule("annotated_sync_count", ">", 0),
     ],
+    "FLEET": [
+        # the fleet observability plane contract (bench.py
+        # --mode=fleet): shipper overhead inside the <2% acceptance
+        # budget, the seeded dead host and seeded cross-host straggler
+        # both attributed EXACTLY (right host, right round), the
+        # injected clock skews recovered by the collector's offset
+        # estimation (merged traces interleave only after correction),
+        # and the collector-outage leg replayed the shipper's buffer
+        # with zero lost and zero dropped events
+        Rule("overhead_shipped_pct", "<", 2.0),
+        Rule("hosts", ">=", 2),
+        Rule("straggler_attributed", "is", True),
+        Rule("dead_detection_exact", "is", True),
+        Rule("clock_offset_bounded", "is", True),
+        Rule("trace_interleaves_after_correction", "is", True),
+        Rule("overhead_lost_events", "==", 0),
+        Rule("outage_push_failures", ">", 0),
+        Rule("outage_replayed_events", ">", 0),
+        Rule("outage_lost_events", "==", 0),
+        Rule("outage_dropped_events", "==", 0),
+    ],
     "DATACACHE": [
         # the I/O-flat contract: a warm (cache-filled, shuffled-
         # assignment) epoch makes ZERO network fetches and is strictly

@@ -36,7 +36,11 @@ from sparknet_tpu.analysis.registry import COMM_SPANS  # noqa: E402
 def load_events(path: str) -> List[dict]:
     """Chrome-JSON or JSONL -> a uniform event list: spans as
     {name, ts (us), dur (us), tid/thread, args}, instants as
-    {name, ts}."""
+    {name, ts}.  Multi-host bundles (the fleet collector's merged
+    ``/runlog`` JSONL or ``/trace`` Chrome JSON — obs/fleet.py) carry a
+    ``host`` per record: the host rides on each event and its thread
+    lane is host-qualified, so two hosts' "MainThread"s never fold into
+    one lane."""
     if path.endswith(".jsonl"):
         events = []
         with open(path) as f:
@@ -45,12 +49,16 @@ def load_events(path: str) -> List[dict]:
                 if not line:
                     continue
                 rec = json.loads(line)
+                host = rec.get("host")
+                thread = rec.get("thread", "?")
                 ev = {
                     "name": rec["name"],
                     "ph": "X" if rec.get("kind") == "span" else "i",
                     "ts": float(rec.get("ts_s", 0.0)) * 1e6,
-                    "tid": rec.get("thread", "?"),
+                    "tid": f"{host}/{thread}" if host else thread,
                 }
+                if host:
+                    ev["host"] = host
                 if rec.get("kind") == "span":
                     ev["dur"] = float(rec.get("dur_ms", 0.0)) * 1e3
                 if rec.get("args"):
@@ -59,7 +67,12 @@ def load_events(path: str) -> List[dict]:
         return events
     with open(path) as f:
         doc = json.load(f)
-    return doc["traceEvents"] if isinstance(doc, dict) else doc
+    events = doc["traceEvents"] if isinstance(doc, dict) else doc
+    for ev in events:
+        host = (ev.get("args") or {}).get("host")
+        if host and "host" not in ev:
+            ev["host"] = host
+    return events
 
 
 def _merge_intervals(spans) -> List[tuple]:
@@ -96,7 +109,11 @@ def _hidden_fraction(by_name: Dict[str, List[dict]]) -> Dict[str, object]:
     time overlapping a DIFFERENT thread's execute/average spans —
     overall, and folded per round (``round=`` span args) into
     p50/min/max.  Rounds whose producer work ran in the open (round 0,
-    the startup prefetch lead, a serial feed) honestly read 0."""
+    the startup prefetch lead, a serial feed) honestly read 0.  In a
+    merged multi-host bundle the overlap is judged WITHIN each host's
+    lane set: host A's assembly under host B's execute is coincidence,
+    not pipelining, and must not count as hidden (nor double-count one
+    producer across N hosts' consumers)."""
     producers = by_name.get("assemble", []) + by_name.get("h2d", [])
     consumers = by_name.get("execute", []) + by_name.get("average", [])
     if not producers:
@@ -105,19 +122,20 @@ def _hidden_fraction(by_name: Dict[str, List[dict]]) -> Dict[str, object]:
     total = 0.0
     hidden = 0.0
     per_round: Dict[object, List[float]] = {}
-    merged_by_tid: Dict[object, List[tuple]] = {}
+    merged_by_lane: Dict[object, List[tuple]] = {}
     for p in producers:
-        tid = p.get("tid")
-        if tid not in merged_by_tid:
-            merged_by_tid[tid] = _merge_intervals(
-                c for c in consumers if c.get("tid") != tid
+        lane = (p.get("host"), p.get("tid"))
+        if lane not in merged_by_lane:
+            merged_by_lane[lane] = _merge_intervals(
+                c for c in consumers
+                if c.get("host") == lane[0] and c.get("tid") != lane[1]
             )
         dur = p.get("dur", 0.0)
-        cov = _overlap_us(p, merged_by_tid[tid]) if dur else 0.0
+        cov = _overlap_us(p, merged_by_lane[lane]) if dur else 0.0
         total += dur
         hidden += cov
         r = (p.get("args") or {}).get("round")
-        acc = per_round.setdefault(r, [0.0, 0.0])
+        acc = per_round.setdefault((p.get("host"), r), [0.0, 0.0])
         acc[0] += dur
         acc[1] += cov
     overall = hidden / total if total > 0 else None
@@ -217,6 +235,24 @@ def fold(events: List[dict]) -> Dict[str, object]:
         "phases": phases,
         "instants": dict(sorted(inst_counts.items())),
     }
+    hosts = sorted({
+        str(e["host"]) for e in spans + instants if e.get("host")
+    })
+    rep["hosts"] = hosts or None
+    # per-host straggler verdicts (the round profiler's per-round
+    # `profile` instants): a merged bundle NAMES the host so "worker 3
+    # was slow" becomes "worker 3 of host-b was slow"
+    stragglers = []
+    for e in instants:
+        a = e.get("args") or {}
+        if e.get("name") == "profile" and a.get("straggler"):
+            stragglers.append({
+                "host": e.get("host"),
+                "round": a.get("round"),
+                "worker": a.get("worst_worker"),
+                "skew": a.get("skew"),
+            })
+    rep["stragglers"] = stragglers
     rep.update(_hidden_fraction(by_name))
     # back-compat boolean (OBS_r09 schema): derived from the measured
     # fraction instead of a separate any-overlap scan
@@ -240,10 +276,21 @@ def format_report(rep: Dict[str, object]) -> str:
             )
         )
     lines.append("wall: %.1f ms" % rep["wall_ms"])
+    if rep.get("hosts"):
+        lines.append("hosts: " + ", ".join(rep["hosts"]))
     if rep["instants"]:
         lines.append(
             "instants: "
             + ", ".join(f"{k} x{v}" for k, v in rep["instants"].items())
+        )
+    for s in rep.get("stragglers") or ():
+        lines.append(
+            "straggler: round %s worker %s%s (skew %s)"
+            % (
+                s["round"], s["worker"],
+                " on host %s" % s["host"] if s["host"] else "",
+                s["skew"],
+            )
         )
     hf = rep.get("producer_hidden_fraction")
     per = rep.get("producer_hidden_fraction_per_round")
