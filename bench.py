@@ -45,9 +45,11 @@ Modes (env):
                         mesh; reports faults injected/survived, recovery
                         latency and the loss band vs the no-fault
                         baseline, incl. the round-12
-                        chunk-cache corruption/cold-wipe faults
-                        + the round-14 fleet-plane
-                        collector outage (CHAOS_r14.json artifact)
+                        chunk-cache corruption/cold-wipe faults,
+                        the round-14 fleet-plane collector outage,
+                        and the round-15 serving-fleet faults
+                        (replica death, corrupt publish rejected at
+                        verify) (CHAOS_r15.json artifact)
   BENCH_MODE=pipeline   pipelined-round-feed A/B (data/round_feed.py
                         RoundFeed): serial assemble->H2D->round loop vs
                         the producer-thread overlapped loop, with a
@@ -129,6 +131,23 @@ Modes (env):
                         (FLEET_r14.json artifact; gated by
                         tools/perf_gate.py --check)
 
+  BENCH_MODE=delivery   serving fleet + train-to-serve delivery proof
+                        (sparknet_tpu/serve/fleet.py + delivery.py):
+                        fleet throughput at 1 vs N replicas (modeled
+                        per-replica device cost + the real-engine leg,
+                        CPU contention disclosed), shed-consistency at
+                        saturation (total 429s invariant across replica
+                        counts at a fixed offered load), a REAL trained
+                        cifar10_quick snapshot published with its
+                        sentry verdict promoting under live traffic
+                        with zero dropped in-flight requests
+                        (bit-identical to a fresh engine), a seeded-bad
+                        (NaN-poisoned) publish auto-rolling-back at
+                        exactly the injected publish, and a mid-traffic
+                        replica kill ejected + respawned with zero
+                        client errors (DELIVERY_r15.json artifact;
+                        gated by tools/perf_gate.py --check)
+
 Modes can also be selected as ``python bench.py --mode=serve`` (flag
 wins over the env var); an unknown mode is rejected.
   BENCH_PROFILE=1       also print the `caffe time`-style per-layer table
@@ -150,7 +169,7 @@ if _REPO not in sys.path:
 
 _MODES = (
     "train", "hostfeed", "scaling", "serve", "chaos", "pipeline", "obs",
-    "health", "profile", "datacache", "sanitize", "fleet",
+    "health", "profile", "datacache", "sanitize", "fleet", "delivery",
 )
 _MODE = os.environ.get("BENCH_MODE", "train")
 for _i, _a in enumerate(sys.argv[1:], start=1):
@@ -3101,6 +3120,458 @@ def bench_fleet():
     print(json.dumps(out))
 
 
+def bench_delivery():
+    """Serving fleet + train-to-serve delivery proof (ISSUE 12
+    acceptance; ``serve/fleet.py`` + ``serve/delivery.py``).
+
+    Legs:
+
+    1. **fleet throughput 1 vs N replicas** — closed-loop clients
+       through the router.  The gated leg wraps each replica's forward
+       with a MODELED per-replica device cost (a sleep standing in for
+       an accelerator executing while the host is free — on a real
+       per-device fleet each replica owns its chip), where throughput
+       must scale with replicas.  The REAL-engine leg runs the actual
+       forwards and is reported alongside UNGATED: on this 1-core CPU
+       box real forwards serialize on the host, so its ratio measures
+       CPU contention, not fleet design (disclosed in the note — the
+       bench_pipeline synthetic-vs-real-leg protocol).
+    2. **shed consistency at saturation** — engines gated closed, M
+       requests offered instantaneously at a fixed fleet admission
+       bound B: exactly M - B shed with 429 regardless of the replica
+       count (the fleet-wide bounded-admission contract).
+    3. **train -> publish -> canary -> promote** — a cifar10_quick
+       solver trains under the health sentry, boots the fleet from an
+       early snapshot, trains on, and publishes with its REAL passing
+       verdict; under live client traffic the delivery watcher
+       verifies, warms off-path, canaries, and promotes — zero client
+       errors across the promote (nothing dropped), and the promoted
+       fleet's outputs are bit-identical to a fresh engine loaded from
+       the same snapshot.
+    4. **seeded-bad publish -> rollback** — the same state with
+       NaN-poisoned params publishes under a FORGED passing verdict
+       (modeling a verdict-pipeline bug; the canary is the last line of
+       defense): the canary diverges non-finite and the watcher rolls
+       back, naming exactly the injected publish, quarantining it, and
+       leaving the incumbent serving.
+    5. **mid-traffic replica kill** — one replica hard-killed under
+       load: the router ejects it on sight, retries its requests on
+       the survivor (zero client errors), and a respawn rejoins.
+    """
+    import tempfile
+    import threading
+
+    import jax
+    import numpy as np
+
+    from sparknet_tpu import config as cfg, models
+    from sparknet_tpu.data.source import synthetic_batches
+    from sparknet_tpu.io import checkpoint
+    from sparknet_tpu.obs.health import HealthSentry
+    from sparknet_tpu.serve import (
+        DeliveryController,
+        InferenceEngine,
+        QueueFull,
+        ReplicaPool,
+        Router,
+    )
+    from sparknet_tpu.serve import publish as publish_mod
+    from sparknet_tpu.solver import Solver
+
+    replicas = int(os.environ.get("BENCH_REPLICAS", "2"))
+    clients = int(os.environ.get("BENCH_CLIENTS", "6"))
+    per_client = int(os.environ.get("BENCH_REQUESTS", "24"))
+    device_cost_ms = float(os.environ.get("BENCH_DEVICE_COST_MS", "25"))
+    decision_requests = int(os.environ.get("BENCH_DECISION_REQUESTS", "8"))
+    train_rounds = int(os.environ.get("BENCH_ROUNDS", "3"))
+    tau = int(os.environ.get("BENCH_TAU", "2"))
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    buckets = [
+        int(b) for b in os.environ.get("BENCH_BUCKETS", "1,4").split(",")
+    ]
+
+    workdir = tempfile.mkdtemp(prefix="bench_delivery_")
+    pub_dir = os.path.join(workdir, "publish")
+
+    # ---- train a REAL model under the sentry (genuine verdicts) ----
+    netp = cfg.replace_data_layers(
+        models.load_model("cifar10_quick"),
+        [(batch, 3, 32, 32), (batch,)],
+        [(batch, 3, 32, 32), (batch,)],
+    )
+    solver = Solver(
+        models.load_model_solver("cifar10_quick"), net_param=netp,
+        audit=True,
+    )
+    sentry = HealthSentry(policy="warn", echo=None)
+    state = solver.init_state(seed=0)
+    state, _ = sentry.guarded_step(
+        solver, state, synthetic_batches(solver.net, tau, seed=0),
+        round_index=0,
+    )
+    boot_model, _ = checkpoint.snapshot(
+        solver, state, os.path.join(workdir, "boot")
+    )
+    for r in range(1, train_rounds):
+        state, _ = sentry.guarded_step(
+            solver, state, synthetic_batches(solver.net, tau, seed=r),
+            round_index=r,
+        )
+    verdict = publish_mod.verdict_from_sentry(sentry)
+    assert verdict["passing"], verdict
+    print(
+        "delivery: trained %d windows; sentry verdict: %s"
+        % (train_rounds, verdict["reason"]),
+        file=sys.stderr,
+    )
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 32, 32).astype(np.float32)
+
+    def make_engine(weights=None):
+        return InferenceEngine(
+            netp, weights=weights if weights is not None else boot_model,
+            buckets=buckets,
+        )
+
+    # ---- leg 1: fleet throughput 1 vs N replicas --------------------
+    def make_modeled_engine(weights=None):
+        eng = make_engine(weights)
+        orig = eng.run_padded
+
+        def run_padded(px):
+            # the modeled per-replica device: the host sleeps while
+            # "the chip" executes — concurrent replicas overlap exactly
+            # as per-device replicas would on real hardware
+            time.sleep(device_cost_ms / 1e3)
+            return orig(px)
+
+        eng.run_padded = run_padded
+        return eng
+
+    def throughput(n, factory):
+        pool = ReplicaPool(factory, replicas=n, max_queue=256)
+        router = Router(pool, max_inflight=256)
+        router.submit(x)  # warm the whole path off the clock
+        errors = []
+
+        def client():
+            try:
+                for _ in range(per_client):
+                    router.submit(x, timeout=120.0)
+            except BaseException as e:  # pragma: no cover
+                errors.append(repr(e))
+
+        threads = [
+            threading.Thread(
+                target=client, name=f"bench-client-{i}", daemon=True
+            )
+            for i in range(clients)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        router.close()
+        assert not errors, errors[:3]
+        return clients * per_client / elapsed
+
+    modeled_1 = throughput(1, make_modeled_engine)
+    modeled_n = throughput(replicas, make_modeled_engine)
+    real_1 = throughput(1, make_engine)
+    real_n = throughput(replicas, make_engine)
+    scaling_modeled = modeled_n / modeled_1
+    scaling_real = real_n / real_1
+    print(
+        "delivery: throughput modeled %.1f -> %.1f img/s (%.2fx at %d "
+        "replicas) | real %.1f -> %.1f img/s (%.2fx, 1-core contention)"
+        % (
+            modeled_1, modeled_n, scaling_modeled, replicas,
+            real_1, real_n, scaling_real,
+        ),
+        file=sys.stderr,
+    )
+
+    # ---- leg 2: shed consistency at saturation ----------------------
+    offered, bound = 48, 16
+    shed_by_replicas = {}
+    for n in (1, replicas):
+        gate = threading.Event()
+
+        def make_gated_engine(weights=None):
+            eng = make_engine(weights)
+            orig = eng.run_padded
+
+            def run_padded(px):
+                gate.wait()
+                return orig(px)
+
+            eng.run_padded = run_padded
+            return eng
+
+        pool = ReplicaPool(make_gated_engine, replicas=n, max_queue=256)
+        router = Router(pool, max_inflight=bound)
+        codes = []
+        lock = threading.Lock()
+
+        def client():
+            try:
+                router.submit(x, timeout=120.0)
+                c = 200
+            except QueueFull:
+                c = 429
+            with lock:
+                codes.append(c)
+
+        threads = [
+            threading.Thread(
+                target=client, name=f"bench-shed-{i}", daemon=True
+            )
+            for i in range(offered)
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.time() + 30
+        while len(codes) < offered - bound and time.time() < deadline:
+            time.sleep(0.01)
+        gate.set()
+        for t in threads:
+            t.join(60)
+        router.close()
+        shed_by_replicas[n] = codes.count(429)
+    shed_invariant_ok = (
+        len(set(shed_by_replicas.values())) == 1
+        and list(shed_by_replicas.values())[0] == offered - bound
+    )
+    print(
+        "delivery: shed at saturation (offered %d, bound %d): %s -> "
+        "invariant %s"
+        % (offered, bound, shed_by_replicas, shed_invariant_ok),
+        file=sys.stderr,
+    )
+
+    # ---- legs 3-5: the live fleet under continuous traffic ----------
+    pool = ReplicaPool(make_engine, replicas=replicas, max_queue=256)
+    router = Router(pool, max_inflight=256, canary_frac=0.25)
+    ctl = DeliveryController(
+        pool, router, pub_dir,
+        cache_dir=os.path.join(workdir, "delivery_cache"),
+        decision_requests=decision_requests,
+        # a healthy further-trained snapshot may legitimately move
+        # softmax outputs a lot; only a poisoned canary (non-finite /
+        # runaway) must fail
+        divergence_max=float(
+            os.environ.get("BENCH_DIVERGENCE_MAX", "100.0")
+        ),
+        echo=lambda m: print(m, file=sys.stderr),
+    )
+    stop_traffic = threading.Event()
+    traffic = {"ok": 0, "shed": 0, "errors": []}
+    tlock = threading.Lock()
+
+    def traffic_client(i):
+        r = np.random.RandomState(100 + i)
+        while not stop_traffic.is_set():
+            xi = r.randn(3, 32, 32).astype(np.float32)
+            try:
+                router.submit(xi, timeout=120.0)
+                with tlock:
+                    traffic["ok"] += 1
+            except QueueFull:
+                with tlock:
+                    traffic["shed"] += 1
+            except BaseException as e:  # pragma: no cover
+                with tlock:
+                    traffic["errors"].append(repr(e))
+                return
+
+    tthreads = [
+        threading.Thread(
+            target=traffic_client, args=(i,),
+            name=f"bench-traffic-{i}", daemon=True,
+        )
+        for i in range(3)
+    ]
+    for t in tthreads:
+        t.start()
+
+    def drive_until(pred, timeout_s=300.0):
+        deadline = time.time() + timeout_s
+        while not pred() and time.time() < deadline:
+            ctl.poll_once()
+            time.sleep(0.05)
+        assert pred(), (ctl.status(), traffic)
+
+    # leg 3: the good publish promotes under live traffic
+    def publish_id_of(paths):
+        mpath = checkpoint.manifest_path_for(paths[1])
+        return os.path.basename(mpath)[: -len(".manifest.json")]
+
+    good_paths = publish_mod.publish_snapshot(
+        solver, state, pub_dir, verdict
+    )
+    good_id = publish_id_of(good_paths)
+    ok_before = traffic["ok"]
+    drive_until(lambda: ctl.promotions == 1)
+    promoted_id = pool.incumbent_id
+    router.submit(x)  # the promoted fleet is live under traffic
+    fresh = InferenceEngine(netp, weights=good_paths[0], buckets=buckets)
+    fresh.warmup()
+    # bit identity is judged engine-vs-engine through the SAME bucket
+    # path: the router may legitimately coalesce a probe into a larger
+    # bucket whose XLA program differs bitwise from the bucket-1 one
+    ref_out = fresh.infer(x)
+    promote_bit_identical = all(
+        np.array_equal(rep.engine.infer(x), ref_out)
+        for rep in pool.replicas
+    )
+    promote_errors = len(traffic["errors"])
+    print(
+        "delivery: %s promoted under traffic (%d requests served "
+        "during the window, %d errors); bit-identical to fresh "
+        "engine: %s"
+        % (
+            promoted_id, traffic["ok"] - ok_before, promote_errors,
+            promote_bit_identical,
+        ),
+        file=sys.stderr,
+    )
+
+    # leg 4: the seeded-bad publish rolls back, named exactly
+    bad_params = jax.tree_util.tree_map(
+        lambda a: np.asarray(a) * np.float32(np.nan),
+        jax.device_get(state.params),
+    )
+    bad_state = state._replace(
+        params=jax.device_put(bad_params),
+        iter=np.asarray(int(state.iter) + tau, np.int32),
+    )
+    bad_paths = publish_mod.publish_snapshot(
+        solver, bad_state, pub_dir,
+        {"passing": True,
+         "reason": "FORGED by the bench (verdict-pipeline bug model)"},
+    )
+    bad_id = publish_id_of(bad_paths)
+    drive_until(lambda: ctl.rollbacks == 1)
+    rollback = ctl.last_decision
+    rollback_named = rollback.get("publish_id")
+    rollback_exact = bool(
+        rollback["action"] == "rolled_back"
+        and rollback_named == bad_id
+        and rollback.get("quarantined")
+    )
+    incumbent_held = all(
+        np.array_equal(rep.engine.infer(x), ref_out)
+        for rep in pool.replicas
+    )
+    rollback_errors = len(traffic["errors"]) - promote_errors
+    print(
+        "delivery: bad publish %s rolled back (named %s, exact %s); "
+        "incumbent held: %s"
+        % (bad_id, rollback_named, rollback_exact, incumbent_held),
+        file=sys.stderr,
+    )
+
+    # leg 5: mid-traffic replica kill -> eject, survive, respawn
+    kill_errors_before = len(traffic["errors"])
+    pool.replicas[0].kill()
+    t_kill = time.time()
+    while (
+        pool.replicas[0].state != "ejected" and time.time() - t_kill < 30
+    ):
+        time.sleep(0.02)
+    kill_ejected = pool.replicas[0].state == "ejected"
+    time.sleep(0.5)  # traffic keeps flowing on the survivor(s)
+    pool.respawn(0)
+    kill_respawned = pool.replicas[0].state == "live"
+    time.sleep(0.5)
+    stop_traffic.set()
+    for t in tthreads:
+        t.join(60)
+    kill_errors = len(traffic["errors"]) - kill_errors_before
+    replica_kill_ok = bool(
+        kill_ejected and kill_respawned and kill_errors == 0
+    )
+    print(
+        "delivery: replica 0 killed mid-traffic: ejected %s, respawned "
+        "%s, client errors %d; traffic total ok=%d shed=%d"
+        % (
+            kill_ejected, kill_respawned, kill_errors, traffic["ok"],
+            traffic["shed"],
+        ),
+        file=sys.stderr,
+    )
+    router.close()
+
+    out = {
+        "metric": "delivery_fleet_images_per_sec",
+        "value": round(modeled_n, 1),
+        "unit": "img/s",
+        "vs_baseline": round(scaling_modeled, 3),
+        "platform": jax.devices()[0].platform,
+        "replicas": replicas,
+        "clients": clients,
+        "buckets": buckets,
+        "device_cost_ms": device_cost_ms,
+        "throughput_modeled_1_img_s": round(modeled_1, 1),
+        "throughput_modeled_fleet_img_s": round(modeled_n, 1),
+        "scaling_ratio_modeled": round(scaling_modeled, 3),
+        "throughput_real_1_img_s": round(real_1, 1),
+        "throughput_real_fleet_img_s": round(real_n, 1),
+        "scaling_ratio_real": round(scaling_real, 3),
+        "shed_offered": offered,
+        "shed_bound": bound,
+        "shed_by_replicas": {
+            str(k): v for k, v in shed_by_replicas.items()
+        },
+        "shed_invariant_ok": shed_invariant_ok,
+        "promoted_publish": promoted_id,
+        "good_publish": good_id,
+        "promote_ok": bool(promoted_id == good_id),
+        "promote_dropped_inflight": promote_errors,
+        "promote_bit_identical": promote_bit_identical,
+        "bad_publish": bad_id,
+        "rollback_named_publish": rollback_named,
+        "rollback_exact": rollback_exact,
+        "rollback_quarantined": [
+            os.path.basename(q) for q in rollback.get("quarantined", [])
+        ],
+        "rollback_dropped_inflight": rollback_errors,
+        "incumbent_held_after_rollback": incumbent_held,
+        "replica_kill_ejected": kill_ejected,
+        "replica_kill_respawned": kill_respawned,
+        "replica_kill_client_errors": kill_errors,
+        "replica_kill_ok": replica_kill_ok,
+        "traffic_ok": traffic["ok"],
+        "traffic_shed": traffic["shed"],
+        "note": "leg 1 measures closed-loop fleet throughput at 1 vs "
+        "%d replicas TWICE: the modeled leg wraps each replica's "
+        "forward in a %.0f ms sleep standing in for a per-replica "
+        "accelerator (host free while the chip executes — the "
+        "per-device fleet this design targets), where the ratio must "
+        "scale; the real-engine leg is disclosed UNGATED because this "
+        "is a 1-core CPU box where every forward serializes on the "
+        "host (ratio ~1.0 measures CPU contention, not fleet design "
+        "— the bench_pipeline synthetic-vs-real protocol).  Leg 2 "
+        "proves the fleet-wide bounded-admission contract: with "
+        "engines gated closed and %d requests offered at bound %d, "
+        "exactly offered-bound shed with 429 at EVERY replica count.  "
+        "Legs 3-5 run live traffic through the fleet while a REAL "
+        "sentry-verdicted cifar10_quick snapshot promotes (zero "
+        "client errors across the hot swap, outputs bit-identical to "
+        "a fresh engine), a NaN-poisoned snapshot published under a "
+        "FORGED passing verdict (verdict-pipeline bug model — the "
+        "canary is the last line of defense) rolls back named at "
+        "exactly the injected publish and quarantined, and a replica "
+        "hard-killed mid-traffic is ejected on sight, its requests "
+        "retried on the survivor (zero client errors), and a respawn "
+        "rejoins rotation." % (replicas, device_cost_ms, offered, bound),
+    }
+    print(json.dumps(out))
+
+
 def main():
     if _MODE == "scaling":
         bench_scaling()
@@ -3134,6 +3605,9 @@ def main():
         return
     if _MODE == "fleet":
         bench_fleet()
+        return
+    if _MODE == "delivery":
+        bench_delivery()
         return
     # the remote-TPU tunnel occasionally drops a request mid-run; one
     # retry keeps the recorded benchmark from dying on a transient

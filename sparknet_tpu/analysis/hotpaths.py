@@ -68,6 +68,11 @@ HOT_PATHS: Dict[str, FrozenSet[str]] = {
         "MicroBatcher._loop",
         "MicroBatcher.submit",
     }),
+    "serve/fleet.py": frozenset({
+        "Router.submit",
+        "Router._pick",
+        "Router._maybe_mirror",
+    }),
     "obs/trace.py": frozenset({
         "_Span.__exit__",
         "span",

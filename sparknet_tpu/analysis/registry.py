@@ -60,6 +60,25 @@ CANONICAL_METRICS = {
     "sparknet_ship_dropped_total": (),
     "sparknet_ship_pushes_total": (),
     "sparknet_ship_push_failures_total": (),
+    # serving fleet (serve/fleet.py, cli serve --replicas) — per-replica
+    # rotation state + fleet lifecycle counters on the pool's registry
+    # (an obs-enabled serve run registers them on the shared training
+    # registry so the PR-10 shipper ships them unchanged)
+    "sparknet_serve_replica_state": ("replica",),
+    "sparknet_serve_replica_inflight": ("replica",),
+    "sparknet_serve_replica_requests_total": ("replica",),
+    "sparknet_serve_replica_errors_total": ("replica",),
+    "sparknet_serve_replica_ejections_total": (),
+    "sparknet_serve_replica_respawns_total": (),
+    "sparknet_serve_replica_engine_swaps_total": (),
+    # train-to-serve delivery (serve/delivery.py, cli serve --watch)
+    "sparknet_delivery_phase": (),
+    "sparknet_delivery_publishes_seen_total": (),
+    "sparknet_delivery_rejected_total": (),
+    "sparknet_delivery_canary_mirrors_total": (),
+    "sparknet_delivery_promotions_total": (),
+    "sparknet_delivery_rollbacks_total": (),
+    "sparknet_delivery_divergence": (),
     # fleet collector (obs/fleet.py, --fleet_collector) — the merged
     # cross-host families on the collector's own /metrics
     "sparknet_fleet_hosts": ("state",),

@@ -52,10 +52,14 @@ import hashlib
 import json
 import os
 import threading
-import zlib
 from typing import List, Optional, Tuple
 
 from sparknet_tpu import obs
+# ONE checksum convention across the framework: the cache's sidecar
+# manifests use the same masked-CRC32 helper the snapshot manifests and
+# the serving delivery watcher verify with (io/checkpoint.py is
+# import-light — the read-only helpers pull no jax).
+from sparknet_tpu.io.checkpoint import crc32_bytes
 
 __all__ = [
     "ChunkCache", "CachingStore", "parse_bytes", "atomic_write_bytes",
@@ -195,7 +199,7 @@ class ChunkCache:
                 f"{chunk_path}: truncated ({len(data)} bytes, manifest "
                 f"says {want_size})"
             )
-        crc = zlib.crc32(data) & 0xFFFFFFFF
+        crc = crc32_bytes(data)
         if crc != want_crc:
             raise CacheCorrupt(
                 f"{chunk_path}: CRC32 mismatch ({crc:#x} vs manifest "
@@ -238,7 +242,7 @@ class ChunkCache:
         # manifest vouching for torn bytes
         meta = {
             "url": url, "name": name, "etag": etag, "size": len(data),
-            "crc32": zlib.crc32(data) & 0xFFFFFFFF,
+            "crc32": crc32_bytes(data),
         }
         atomic_write_bytes(meta_path, json.dumps(meta).encode())
         with self._lock:

@@ -45,15 +45,21 @@ _STATE_SUFFIXES = (".solverstate.npz", ".solverstate.h5")
 class SnapshotCorrupt(RuntimeError):
     """A snapshot failed CRC/size verification or could not be decoded."""
 
-import jax
 import numpy as np
 
 from sparknet_tpu import obs
 from sparknet_tpu.io import caffemodel
-from sparknet_tpu.solver import Solver, TrainState
+
+# jax and the Solver stack import LAZILY (inside the functions that
+# touch live state): the read-only manifest/CRC helpers below are shared
+# with the data plane (``data/chunk_cache.py``) and the serving delivery
+# watcher (``serve/delivery.py``), which must be able to verify a
+# published snapshot WITHOUT pulling jax or constructing a solver.
 
 
 def _flatten_history(history):
+    import jax
+
     leaves, treedef = jax.tree_util.tree_flatten(history)
     return leaves, treedef
 
@@ -70,7 +76,13 @@ def _atomic(write_fn, path: str) -> None:
             os.unlink(tmp)
 
 
-def _crc32_file(path: str) -> Tuple[int, int]:
+def crc32_bytes(data: bytes) -> int:
+    """The framework's one checksum convention (manifest ``crc32``
+    fields, chunk-cache sidecars): masked ``zlib.crc32``."""
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def crc32_file(path: str) -> Tuple[int, int]:
     """Streaming (crc32, size) of a file."""
     crc = 0
     size = 0
@@ -81,6 +93,9 @@ def _crc32_file(path: str) -> Tuple[int, int]:
                 return crc & 0xFFFFFFFF, size
             crc = zlib.crc32(chunk, crc)
             size += len(chunk)
+
+
+_crc32_file = crc32_file  # pre-round-15 private name, kept for callers
 
 
 def manifest_path_for(path: str) -> str:
@@ -110,41 +125,91 @@ def _write_manifest(it: int, fmt: str, paths: Tuple[str, str]) -> str:
     return mpath
 
 
+def read_manifest(mpath: str) -> dict:
+    """Decode a snapshot manifest — read-only, no solver, no jax.
+    OSError (transient I/O on flaky storage — the very environment this
+    layer targets) propagates as-is: only DECODE failure of the manifest
+    is evidence of corruption.  ``restore_newest_valid`` treats plain
+    OSError as non-corruption and leaves the snapshot intact."""
+    with open(mpath) as f:
+        raw = f.read()
+    return parse_manifest(raw, label=mpath)
+
+
+def parse_manifest(raw, label: str = "<manifest>") -> dict:
+    """Manifest bytes/text -> dict, raising ``SnapshotCorrupt`` on
+    garbage (the delivery watcher feeds this bytes fetched through an
+    object store / chunk cache rather than a local path)."""
+    try:
+        if isinstance(raw, bytes):
+            raw = raw.decode("utf-8")
+        manifest = json.loads(raw)
+        if not isinstance(manifest["files"], dict):
+            raise TypeError("'files' is not a mapping")
+    except (ValueError, KeyError, TypeError) as e:
+        raise SnapshotCorrupt(f"{label}: unreadable manifest: {e}") from e
+    return manifest
+
+
+def verify_file_entry(path: str, want: dict) -> None:
+    """CRC32/size-check ONE on-disk file against its manifest entry."""
+    if not os.path.exists(path):
+        raise SnapshotCorrupt(f"{path}: listed in manifest but missing")
+    crc, size = crc32_file(path)
+    if size != int(want["size"]):
+        raise SnapshotCorrupt(
+            f"{path}: truncated ({size} bytes, manifest says "
+            f"{want['size']})"
+        )
+    if crc != int(want["crc32"]):
+        raise SnapshotCorrupt(
+            f"{path}: CRC32 mismatch ({crc:#x} vs manifest "
+            f"{int(want['crc32']):#x})"
+        )
+
+
+def verify_bytes_entry(name: str, data: bytes, manifest: dict) -> None:
+    """CRC32/size-check fetched BYTES against the manifest's entry for
+    ``name`` — the delivery watcher's verify, where the file arrived
+    through an object store and never touched the local disk under its
+    published name."""
+    want = manifest["files"].get(os.path.basename(name))
+    if want is None:
+        raise SnapshotCorrupt(f"{name}: not listed in the manifest")
+    if len(data) != int(want["size"]):
+        raise SnapshotCorrupt(
+            f"{name}: truncated ({len(data)} bytes, manifest says "
+            f"{want['size']})"
+        )
+    crc = crc32_bytes(data)
+    if crc != int(want["crc32"]):
+        raise SnapshotCorrupt(
+            f"{name}: CRC32 mismatch ({crc:#x} vs manifest "
+            f"{int(want['crc32']):#x})"
+        )
+
+
+def verify_manifest(mpath: str) -> Optional[dict]:
+    """Read-only verify of every file a manifest lists (no solver, no
+    jax — shared by ``restore()``, the chunk cache's snapshot staging,
+    and the serving delivery watcher).  Returns the decoded manifest,
+    or None when no manifest exists (pre-manifest snapshots pass).
+    Raises ``SnapshotCorrupt`` on truncation/mismatch/missing files."""
+    if not os.path.exists(mpath):
+        return None
+    manifest = read_manifest(mpath)
+    d = os.path.dirname(mpath)
+    for name, want in manifest["files"].items():
+        verify_file_entry(os.path.join(d, name), want)
+    return manifest
+
+
 def verify_snapshot(state_path: str) -> None:
     """CRC32/size-check every file the snapshot's manifest lists.
     Raises ``SnapshotCorrupt`` on truncation/mismatch/missing files; a
     snapshot with NO manifest (pre-manifest format) passes — decode
     errors are still caught by ``restore_newest_valid``."""
-    mpath = manifest_path_for(state_path)
-    if not os.path.exists(mpath):
-        return
-    # OSError (transient I/O on flaky storage — the very environment
-    # this layer targets) propagates as-is: only DECODE failure of the
-    # manifest is evidence of corruption.  restore_newest_valid treats
-    # plain OSError as non-corruption and leaves the snapshot intact.
-    with open(mpath) as f:
-        raw = f.read()
-    try:
-        manifest = json.loads(raw)
-        files = manifest["files"]
-    except (ValueError, KeyError, TypeError) as e:
-        raise SnapshotCorrupt(f"{mpath}: unreadable manifest: {e}") from e
-    d = os.path.dirname(state_path)
-    for name, want in files.items():
-        p = os.path.join(d, name)
-        if not os.path.exists(p):
-            raise SnapshotCorrupt(f"{p}: listed in manifest but missing")
-        crc, size = _crc32_file(p)
-        if size != int(want["size"]):
-            raise SnapshotCorrupt(
-                f"{p}: truncated ({size} bytes, manifest says "
-                f"{want['size']})"
-            )
-        if crc != int(want["crc32"]):
-            raise SnapshotCorrupt(
-                f"{p}: CRC32 mismatch ({crc:#x} vs manifest "
-                f"{int(want['crc32']):#x})"
-            )
+    verify_manifest(manifest_path_for(state_path))
 
 
 def _write_snapshot(
@@ -199,7 +264,9 @@ def _write_snapshot_inner(
     return model_path, state_path
 
 
-def _host_snapshot_args(solver: Solver, state: TrainState, fmt: str):
+def _host_snapshot_args(solver, state, fmt: str):
+    import jax
+
     fmt = (fmt or solver.param.snapshot_format or "BINARYPROTO").upper()
     it = int(jax.device_get(state.iter))
     # net_blobs np.asarray()s every blob — the host transfer happens
@@ -213,7 +280,7 @@ def _host_snapshot_args(solver: Solver, state: TrainState, fmt: str):
 
 
 def snapshot(
-    solver: Solver, state: TrainState, prefix: str, fmt: str = None
+    solver, state, prefix: str, fmt: str = None
 ) -> Tuple[str, str]:
     """Write model + solver state; returns (model_path, state_path).
     ``fmt`` overrides ``solver.param.snapshot_format``."""
@@ -241,7 +308,7 @@ class AsyncCheckpointer:
         self._last_paths: Optional[Tuple[str, str]] = None
 
     def save(
-        self, solver: Solver, state: TrainState, prefix: str, fmt: str = None
+        self, solver, state, prefix: str, fmt: str = None
     ) -> None:
         import threading
 
@@ -284,11 +351,11 @@ def _load_model_blobs(model_path: str):
 
 
 def restore(
-    solver: Solver,
+    solver,
     prefix_or_state_path: str,
     seed: int = 0,
     verify: bool = True,
-) -> TrainState:
+):
     """Rebuild a TrainState from a snapshot (``Solver::Restore`` +
     ``restore_solver_from_file``, ccaffe.cpp:271-273).  Accepts either a
     ``.solverstate.npz`` or ``.solverstate.h5`` path.  When the snapshot
@@ -305,11 +372,15 @@ def restore(
 
 
 def _restore_impl(
-    solver: Solver,
+    solver,
     prefix_or_state_path: str,
     seed: int = 0,
     verify: bool = True,
-) -> TrainState:
+):
+    import jax
+
+    from sparknet_tpu.solver import TrainState
+
     state_path = prefix_or_state_path
     if verify:
         with obs.span("verify", path=os.path.basename(state_path)):
@@ -388,11 +459,11 @@ def _quarantine(state_path: str) -> List[str]:
 
 
 def restore_newest_valid(
-    solver: Solver,
+    solver,
     prefix: str,
     seed: int = 0,
     quarantine: bool = True,
-) -> Tuple[TrainState, str]:
+):
     """Resume from the newest snapshot that VERIFIES — the fault-
     tolerant ``--resume`` path.  Walks ``find_snapshots(prefix)`` newest
     first; a snapshot that fails its manifest check or cannot be decoded
@@ -437,12 +508,12 @@ def restore_newest_valid(
     )
 
 
-def load_weights_into_state(
-    solver: Solver, state: TrainState, model_path: str
-) -> TrainState:
+def load_weights_into_state(solver, state, model_path: str):
     """Warm start from a .caffemodel or .caffemodel.h5 only (the
     ``--weights=`` / ``loadWeightsFromFile`` path, Net.scala:238-240):
     history and iter keep their current values."""
+    import jax
+
     loaded = _load_model_blobs(model_path)
     params, stats = caffemodel.apply_blobs(
         solver.net, jax.device_get(state.params), jax.device_get(state.stats), loaded

@@ -38,6 +38,15 @@ real TPU pod into a small cifar10_quick run on the virtual mesh —
 - **cache cold**: the whole cache is wiped at a seeded round (host
   restart / cache-volume loss stand-in); the read must miss, refetch,
   and training must not notice.
+- **replica death**: one replica of a serving fleet
+  (``serve/fleet.py``) is hard-killed mid-traffic; the router must
+  eject it on sight, retry its in-flight requests on live siblings
+  (zero client errors), and ``respawn`` must return it to rotation.
+- **published snapshot corrupt**: a snapshot published for delivery
+  (``serve/publish.py``) has its model bytes flipped on disk (size
+  unchanged); the delivery watcher (``serve/delivery.py``) must
+  REJECT it at CRC verify — it must never reach a canary — and
+  quarantine the publish ``*.corrupt``.
 
 Every fault is counted as injected and (when the run recovers) survived;
 ``bench.py --mode=chaos`` emits the ``CHAOS_r07.json`` artifact
@@ -138,6 +147,21 @@ class FaultPlan:
     # preemption so the two faults don't compound.
     collector_outage_round: Optional[int] = 1
     collector_outage_rounds: int = 2
+    # replica_death: at the END of this round a 2-replica serving fleet
+    # (built lazily on the chaos box, tiny toy net) loses replica 0 to
+    # a hard kill mid-traffic.  Survived = every subsequent request is
+    # served (router eject-and-retry, zero client errors), the dead
+    # replica reads `ejected`, and a respawn returns it to rotation.
+    # AFTER the preemption: the fleet is rebuilt lazily on the resumed
+    # process, and the fire-once guard keeps a replay from re-killing.
+    replica_death_round: Optional[int] = 4
+    # published_snapshot_corrupt: at the END of this round the current
+    # training state is PUBLISHED for delivery (passing verdict
+    # attached) and its model bytes are then flipped on disk (size
+    # unchanged — only the manifest CRC can catch it).  Survived = the
+    # delivery watcher rejects it at verify (it never reaches a
+    # canary) and quarantines the publish *.corrupt.
+    publish_corrupt_round: Optional[int] = 5
 
     @classmethod
     def default(cls) -> "FaultPlan":
@@ -157,6 +181,8 @@ class FaultPlan:
             cache_corrupt_round=None,
             cache_cold_round=None,
             collector_outage_round=None,
+            replica_death_round=None,
+            publish_corrupt_round=None,
         )
 
 
@@ -338,6 +364,141 @@ class _CollectorOutage:
         if self.shipper.alive:
             self.shipper.stop()
         self.collector.close()
+
+
+# deploy view of the serving-fleet fault fixture: tiny net, tiny input,
+# two buckets — the fleet compiles in seconds on the chaos box
+_SERVE_TOY_DEPLOY = """
+name: "chaos_toy"
+input: "data"
+input_shape { dim: 2 dim: 3 dim: 8 dim: 8 }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "logits"
+  inner_product_param { num_output: 5 weight_filler { type: "xavier" } } }
+layer { name: "prob" type: "Softmax" bottom: "logits" top: "prob" }
+"""
+
+
+class _ServeFaults:
+    """The serving-fleet faults: ``replica_death`` and
+    ``published_snapshot_corrupt``, run as bounded sub-scenarios at
+    seeded round boundaries (fire once, by absolute round — a
+    post-resume replay can't re-fire them).  The fleet is a real
+    ``serve/fleet.py`` pool (2 replicas, toy net) built lazily on
+    first use; the corrupt-publish leg publishes the ACTUAL training
+    state of the chaos run through ``serve/publish.py`` and corrupts
+    the published model bytes."""
+
+    def __init__(self, plan: FaultPlan, counters: Dict, note, workdir: str):
+        self.plan = plan
+        self.counters = counters
+        self.note = note
+        self.workdir = workdir
+        self._death_at = plan.replica_death_round
+        self._corrupt_at = plan.publish_corrupt_round
+        self._pool = None
+        self._router = None
+        self._x = np.random.RandomState(plan.seed).randn(
+            1, 3, 8, 8
+        ).astype(np.float32)
+
+    def _fleet(self):
+        if self._pool is None:
+            from sparknet_tpu import config as _cfg
+            from sparknet_tpu.serve import (
+                InferenceEngine, ReplicaPool, Router,
+            )
+
+            netp = _cfg.parse_net_prototxt(_SERVE_TOY_DEPLOY)
+
+            def make_engine(weights=None):
+                return InferenceEngine(
+                    netp, weights=weights, buckets=(1, 2)
+                )
+
+            self._pool = ReplicaPool(make_engine, replicas=2, max_queue=32)
+            self._router = Router(self._pool, max_inflight=16)
+        return self._pool, self._router
+
+    def on_round_end(self, r: int, solver, host_state_fn) -> None:
+        if self._death_at is not None and r == self._death_at:
+            self._death_at = None
+            self._replica_death(r)
+        if self._corrupt_at is not None and r == self._corrupt_at:
+            self._corrupt_at = None
+            self._publish_corrupt(r, solver, host_state_fn)
+
+    def _replica_death(self, r: int) -> None:
+        pool, router = self._fleet()
+        router.submit(self._x)  # fleet proven serving before the kill
+        self.counters["replica_death_injected"] = 1
+        _obs.fault("replica_death", round=r, replica=0)
+        self.note(f"round {r}: serving replica 0 hard-killed mid-traffic")
+        pool.replicas[0].kill()
+        served = 0
+        for _ in range(4):
+            out = router.submit(self._x)  # eject-and-retry: no errors
+            served += int(out.shape[0] == 1)
+        ejected = pool.replicas[0].state == "ejected"
+        pool.respawn(0)
+        rejoined = pool.replicas[0].state == "live"
+        router.submit(self._x)
+        if served == 4 and ejected and rejoined:
+            self.counters["replica_death_survived"] = 1
+            self.note(
+                f"round {r}: router ejected the dead replica, served "
+                "every request on the survivor, and the respawned "
+                "replica rejoined rotation"
+            )
+            _obs.instant("recovered", kind="replica_death", round=r)
+
+    def _publish_corrupt(self, r: int, solver, host_state_fn) -> None:
+        from sparknet_tpu.serve import DeliveryController
+        from sparknet_tpu.serve import publish as publish_mod
+
+        pub = os.path.join(self.workdir, "publish")
+        paths = publish_mod.publish_snapshot(
+            solver, host_state_fn(), pub,
+            {"passing": True, "reason": "chaos seeded publish"},
+        )
+        corrupt_file(paths[0], seed=self.plan.seed)
+        self.counters["publish_corrupt_injected"] = 1
+        _obs.fault(
+            "published_snapshot_corrupt", round=r,
+            snapshot=os.path.basename(paths[0]),
+        )
+        self.note(
+            f"round {r}: published snapshot "
+            f"{os.path.basename(paths[0])} byte-flipped on disk"
+        )
+        pool, router = self._fleet()
+        ctl = DeliveryController(
+            pool, router, pub,
+            cache_dir=os.path.join(self.workdir, "delivery_cache"),
+            decision_requests=2, echo=None,
+        )
+        act = ctl.poll_once()
+        quarantined = (ctl.last_decision or {}).get("quarantined", [])
+        if (
+            act == "rejected"
+            and ctl.rejected == 1
+            and router.canary is None  # it never reached a canary
+            and any(q.endswith(".corrupt") for q in quarantined)
+        ):
+            self.counters["publish_corrupt_survived"] = 1
+            self.note(
+                f"round {r}: delivery watcher REJECTED the corrupt "
+                "publish at CRC verify and quarantined it "
+                "(never canaried)"
+            )
+            _obs.instant(
+                "recovered", kind="published_snapshot_corrupt", round=r
+            )
+
+    def close(self) -> None:
+        if self._router is not None:
+            self._router.close()
+            self._router = None
+            self._pool = None
 
 
 # ----------------------------------------------------------------------
@@ -831,6 +992,11 @@ def run_chaos(
                 )
         if outage is not None:
             outage.on_round_end(r)
+        if serve_faults is not None:
+            serve_faults.on_round_end(
+                r, solver,
+                lambda: first_worker(jax.device_get(state)),
+            )
 
     # the round profiler attributes the seeded straggler (installed for
     # the faulted run only; the baseline above ran unprofiled)
@@ -842,6 +1008,13 @@ def run_chaos(
     outage = None
     if plan.collector_outage_round is not None:
         outage = _CollectorOutage(plan, counters, note)
+    # the serving-fleet faults (replica_death, published_snapshot_corrupt)
+    serve_faults = None
+    if (
+        plan.replica_death_round is not None
+        or plan.publish_corrupt_round is not None
+    ):
+        serve_faults = _ServeFaults(plan, counters, note, workdir)
     t_preempt = None
     try:
         with SignalHandler(
@@ -933,6 +1106,8 @@ def run_chaos(
     finally:
         if profiler is not None:
             _profile.uninstall(profiler)
+        if serve_faults is not None:
+            serve_faults.close()
         if outage is not None:
             try:
                 outage.finalize()
@@ -970,6 +1145,12 @@ def run_chaos(
         "collector_outage": (
             "collector_outage_injected", "collector_outage_survived",
         ),
+        "replica_death": (
+            "replica_death_injected", "replica_death_survived",
+        ),
+        "published_snapshot_corrupt": (
+            "publish_corrupt_injected", "publish_corrupt_survived",
+        ),
     }
     faults = {
         kind: {
@@ -1001,6 +1182,8 @@ def run_chaos(
         "cache_cold_round": plan.cache_cold_round,
         "collector_outage_round": plan.collector_outage_round,
         "collector_outage": outage.summary if outage is not None else None,
+        "replica_death_round": plan.replica_death_round,
+        "publish_corrupt_round": plan.publish_corrupt_round,
         # the faulted run's own cache traffic (baseline-leg reads on the
         # shared cache subtracted out)
         "cache_stats": {

@@ -1,8 +1,10 @@
-"""TPU-native inference serving: engine + dynamic micro-batching + HTTP.
+"""TPU-native inference serving: engine + micro-batching + fleet + HTTP.
 
 The serving L-layer over the training framework (ARCHITECTURE.md): a
 trained net (zoo name or prototxt, ``.caffemodel`` or snapshot weights)
-becomes a high-throughput request-serving engine.
+becomes a high-throughput request-serving engine — and a FLEET of them
+behind a load-shedding router, fed continuously by training through the
+publish -> verify -> canary -> promote/rollback delivery loop.
 
 - ``engine.InferenceEngine``  — deploy-net loader; pre-compiles jitted
   forward fns for a fixed set of static batch-size buckets so no XLA
@@ -10,19 +12,39 @@ becomes a high-throughput request-serving engine.
 - ``batcher.MicroBatcher``    — bounded admission queue that coalesces
   concurrent requests into the largest ready bucket under a max-wait
   deadline (pad-and-mask static shapes), then demuxes per-request.
+- ``fleet.ReplicaPool``/``fleet.Router`` — N shared-nothing replicas
+  (thread-per-replica, per-device) behind min-in-flight routing with a
+  FLEET-WIDE bounded-admission 429 contract, eject-and-retry on dead
+  replicas, hot engine swap, and canary mirroring.
+- ``publish``/``delivery``    — train-to-serve continuous delivery: the
+  trainer publishes sentry-verified snapshots (CRC manifest + health
+  verdict), the delivery watcher CRC-verifies, warms a standby engine
+  off-path, canaries live traffic, and promotes or rolls back.
 - ``server.ServeServer``      — stdlib-only HTTP front-end: ``/predict``,
-  ``/healthz``, ``/metrics``; 429 load-shedding on queue overflow and
-  graceful drain on SIGTERM (``utils/signals.py``).
-- ``metrics``                 — counters/gauges/histograms rendered in
-  Prometheus text format.
+  ``/healthz`` (per-replica state + delivery phase), ``/metrics``; 429
+  load-shedding and graceful drain on SIGTERM (``utils/signals.py``).
+
+Metrics register on the shared ``sparknet_tpu.obs.metrics`` registry
+shape (``serve.metrics`` is a deprecation shim).
 """
 
-from sparknet_tpu.serve.batcher import MicroBatcher, QueueFull  # noqa: F401
-from sparknet_tpu.serve.engine import InferenceEngine  # noqa: F401
-from sparknet_tpu.serve.metrics import (  # noqa: F401
+from sparknet_tpu.obs.metrics import (  # noqa: F401
     Counter,
     Gauge,
     Histogram,
     MetricsRegistry,
+)
+from sparknet_tpu.serve.batcher import MicroBatcher, QueueFull  # noqa: F401
+from sparknet_tpu.serve.delivery import DeliveryController  # noqa: F401
+from sparknet_tpu.serve.engine import InferenceEngine  # noqa: F401
+from sparknet_tpu.serve.fleet import (  # noqa: F401
+    FleetUnservable,
+    Replica,
+    ReplicaPool,
+    Router,
+)
+from sparknet_tpu.serve.publish import (  # noqa: F401
+    PublishRefused,
+    publish_snapshot,
 )
 from sparknet_tpu.serve.server import ServeServer  # noqa: F401

@@ -24,8 +24,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from sparknet_tpu.obs.metrics import MetricsRegistry
 from sparknet_tpu.serve.engine import InferenceEngine
-from sparknet_tpu.serve.metrics import MetricsRegistry
 
 
 class QueueFull(RuntimeError):
@@ -116,7 +116,9 @@ class MicroBatcher:
             "serve_jit_cache_size",
             "compiled programs behind the forward fn (constant after "
             "warmup iff no recompiles)",
-            fn=engine.jit_cache_size,
+            # read through self.engine, not the constructor argument: a
+            # hot engine swap (serve/fleet.py) must re-point the gauge
+            fn=lambda: self.engine.jit_cache_size(),
         )
 
         self._worker = threading.Thread(
@@ -202,19 +204,23 @@ class MicroBatcher:
 
     def _serve_batch(self, taken: List[_Request]) -> None:
         items = sum(r.n for r in taken)
+        # ONE engine read per batch: a hot engine swap (serve/fleet.py
+        # Replica.swap_engine) lands between batches, never inside one —
+        # this batch's pad/run/demux all see the same engine
+        eng = self.engine
         try:
             x = (
                 taken[0].x
                 if len(taken) == 1
                 else np.concatenate([r.x for r in taken], axis=0)
             )
-            if items <= self.engine.max_bucket:
-                padded, n = self.engine.pad_to_bucket(x)
-                out = self.engine.run_padded(padded)[:n]
+            if items <= eng.max_bucket:
+                padded, n = eng.pad_to_bucket(x)
+                out = eng.run_padded(padded)[:n]
                 bucket = padded.shape[0]
             else:  # oversized single request: chunked single-shot path
-                out = self.engine.infer(x)
-                bucket = self.engine.max_bucket
+                out = eng.infer(x)
+                bucket = eng.max_bucket
             self.m_batches.inc()
             self.m_batch_items.observe(items)
             self.m_occupancy.observe(min(1.0, items / bucket))

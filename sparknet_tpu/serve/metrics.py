@@ -1,19 +1,9 @@
-"""Serving metrics — thin re-export of the shared observability layer.
+"""Deprecated alias of :mod:`sparknet_tpu.obs.metrics`.
 
-The Counter/Gauge/Histogram instruments and the Prometheus-text
-``MetricsRegistry`` were born here in round 6; round 9 promoted them to
-``sparknet_tpu/obs/metrics.py`` so training and serving register series
-on ONE implementation (the training sidecar and the serving front-end
-render the identical exposition format).  Import from either path;
-this module exists so serving call sites never changed.
+The serving instruments were promoted to the shared observability layer
+in round 9; round 15 folded the re-export away — every serve module now
+imports ``sparknet_tpu.obs.metrics`` directly.  This shim keeps
+``sparknet_tpu.serve.metrics`` importable for external callers only.
 """
 
-from sparknet_tpu.obs.metrics import (  # noqa: F401
-    LATENCY_BUCKETS_S,
-    Counter,
-    Gauge,
-    Histogram,
-    MetricFamily,
-    MetricsRegistry,
-    _fmt,
-)
+from sparknet_tpu.obs.metrics import *  # noqa: F401,F403 — deprecation shim

@@ -1,20 +1,30 @@
-"""Stdlib HTTP front-end for the inference engine.
+"""Stdlib HTTP front-end for the inference engine — or a whole fleet.
 
 ``http.server.ThreadingHTTPServer`` (one thread per connection) over a
-shared ``MicroBatcher`` — handler threads block in ``submit`` while the
-worker coalesces their requests into one forward pass, which is exactly
-the concurrency the micro-batcher feeds on.
+shared ``MicroBatcher`` (single-engine mode) or a ``serve/fleet.py``
+``Router`` (fleet mode) — handler threads block in ``submit`` while the
+worker(s) coalesce their requests into forward passes, which is exactly
+the concurrency the micro-batchers feed on.
 
 Endpoints:
   POST /predict   body {"data": <nested list, (n,C,H,W) or (C,H,W)>}
                   -> {"outputs": [...], "shape": [...], "batched": n}
-                  429 when the admission queue is full (load shedding),
-                  503 while draining, 400 on malformed input.
-  GET  /healthz   {"status": "ok"} | 503 {"status": "draining"}
-  GET  /metrics   Prometheus text format (serve/metrics.py)
+                  429 when admission sheds (fleet-wide bound in fleet
+                  mode), 503 while draining or when NO live replica
+                  remains, 400 on malformed input.
+  GET  /healthz   single engine: {"status": "ok"} | 503 draining.
+                  fleet: per-replica state rows (live/draining/ejected)
+                  + the delivery phase block (incumbent, canary,
+                  decision-window progress).  503 ONLY when the whole
+                  fleet is unservable (draining, or zero live
+                  replicas) — one draining/ejected replica keeps the
+                  endpoint 200 so an LB doesn't pull a healthy fleet.
+  GET  /metrics   Prometheus text (the shared obs.metrics registry; in
+                  fleet mode the per-replica sparknet_serve_replica_*
+                  families + fleet sums render here).
 
 Graceful drain: SIGTERM/SIGINT (via ``utils/signals.py`` SignalHandler)
-flips /healthz to 503 (LB takes the replica out of rotation), stops
+flips /healthz to 503 (LB takes the server out of rotation), stops
 admitting new work, serves everything queued, then shuts the listener
 down.
 """
@@ -33,7 +43,10 @@ import numpy as np
 from sparknet_tpu.obs.exporter import JsonHTTPHandler
 from sparknet_tpu.serve.batcher import MicroBatcher, QueueFull
 from sparknet_tpu.serve.engine import InferenceEngine
+from sparknet_tpu.serve.fleet import FleetUnservable, Router
 from sparknet_tpu.utils.signals import SignalHandler, SolverAction
+
+_RETRY = [("Retry-After", "1")]
 
 
 class _Handler(JsonHTTPHandler):
@@ -54,16 +67,14 @@ class _Handler(JsonHTTPHandler):
     def do_GET(self):
         ctx = self.server_ctx
         if self.path == "/healthz":
-            if ctx.draining:
-                # Retry-After on every 503/429: retrying clients (e.g.
-                # utils/retry.py honors the header) back off instead of
-                # hammering a replica that is leaving rotation
-                self._send_json(
-                    503, {"status": "draining"},
-                    extra_headers=[("Retry-After", "1")],
-                )
-            else:
-                self._send_json(200, {"status": "ok"})
+            code, payload = ctx.health_payload()
+            # Retry-After on every 503/429: retrying clients (e.g.
+            # utils/retry.py honors the header) back off instead of
+            # hammering a server that is leaving rotation
+            self._send_json(
+                code, payload,
+                extra_headers=_RETRY if code == 503 else (),
+            )
         elif self.path == "/metrics":
             self._send(
                 200,
@@ -97,8 +108,7 @@ class _Handler(JsonHTTPHandler):
     def _predict(self, ctx: "ServeServer", raw: bytes) -> None:
         if ctx.draining:
             self._send_json(
-                503, {"status": "draining"},
-                extra_headers=[("Retry-After", "1")],
+                503, {"status": "draining"}, extra_headers=_RETRY
             )
             return
         try:
@@ -107,28 +117,35 @@ class _Handler(JsonHTTPHandler):
         except (ValueError, KeyError, TypeError) as e:
             self._send_json(400, {"error": f"bad request body: {e}"})
             return
-        item_ndim = len(ctx.engine.item_shape)
+        item_ndim = len(ctx.item_shape)
         if x.ndim == item_ndim + 1 and x.shape[0] == 0:
             self._send_json(400, {"error": "empty batch"})
             return
         if x.ndim not in (item_ndim, item_ndim + 1) or (
-            tuple(x.shape[-item_ndim:]) != ctx.engine.item_shape
+            tuple(x.shape[-item_ndim:]) != ctx.item_shape
         ):
             self._send_json(
                 400,
                 {
                     "error": "input shape %s does not match net input %s"
-                    % (list(x.shape), list(ctx.engine.item_shape))
+                    % (list(x.shape), list(ctx.item_shape))
                 },
             )
             return
         try:
-            out = ctx.batcher.submit(x, timeout=ctx.request_timeout_s)
+            out = ctx.submit(x, timeout=ctx.request_timeout_s)
         except QueueFull:
             self._send_json(
                 429,
                 {"error": "queue full, retry later"},
-                extra_headers=[("Retry-After", "1")],
+                extra_headers=_RETRY,
+            )
+            return
+        except FleetUnservable as e:
+            # the WHOLE fleet is out — the only replica-related 503
+            self._send_json(
+                503, {"status": "unservable", "error": str(e)},
+                extra_headers=_RETRY,
             )
             return
         except TimeoutError as e:
@@ -141,8 +158,7 @@ class _Handler(JsonHTTPHandler):
             # would keep routing while operators chase a phantom drain
             if ctx.draining:
                 self._send_json(
-                    503, {"status": "draining"},
-                    extra_headers=[("Retry-After", "1")],
+                    503, {"status": "draining"}, extra_headers=_RETRY
                 )
             else:
                 self._send_json(500, {"error": f"inference failed: {e}"})
@@ -161,7 +177,10 @@ class _Handler(JsonHTTPHandler):
 
 
 class ServeServer:
-    """Engine + micro-batcher + HTTP listener, with signal-driven drain.
+    """HTTP listener over one engine (engine + micro-batcher) or a
+    replicated fleet (``router=`` a ``serve/fleet.py`` Router, with an
+    optional ``delivery=`` controller feeding the /healthz delivery
+    block), with signal-driven drain.
 
     ``run()`` blocks until SIGTERM/SIGINT (must be called from the main
     thread — CPython restricts signal handler installation); tests drive
@@ -171,20 +190,30 @@ class ServeServer:
 
     def __init__(
         self,
-        engine: InferenceEngine,
+        engine: Optional[InferenceEngine] = None,
         host: str = "127.0.0.1",
         port: int = 8361,
         max_queue: int = 256,
         max_wait_ms: float = 2.0,
         request_timeout_s: float = 60.0,
         verbose: bool = False,
+        router: Optional[Router] = None,
+        delivery=None,
     ):
+        if (engine is None) == (router is None):
+            raise ValueError("pass exactly one of engine= or router=")
         self.engine = engine
-        self.batcher = MicroBatcher(
-            engine, max_queue=max_queue, max_wait_ms=max_wait_ms
-        )
-        self.metrics = self.batcher.metrics
-        # front-end series ride on the SAME shared registry the batcher
+        self.router = router
+        self.delivery = delivery
+        if router is not None:
+            self.batcher = None
+            self.metrics = router.pool.registry
+        else:
+            self.batcher = MicroBatcher(
+                engine, max_queue=max_queue, max_wait_ms=max_wait_ms
+            )
+            self.metrics = self.batcher.metrics
+        # front-end series ride on the SAME shared registry the backend
         # built (obs.metrics) — one /metrics payload, no second registry
         t0 = time.monotonic()
         self.m_uptime = self.metrics.gauge(
@@ -215,8 +244,53 @@ class ServeServer:
         return self.httpd.server_address[:2]
 
     @property
+    def item_shape(self):
+        if self.router is not None:
+            return self.router.item_shape
+        return self.engine.item_shape
+
+    def submit(self, x, timeout=None):
+        if self.router is not None:
+            return self.router.submit(x, timeout=timeout)
+        return self.batcher.submit(x, timeout=timeout)
+
+    @property
     def draining(self) -> bool:
+        if self.router is not None:
+            return self._drain_evt.is_set() or self.router.draining
         return self._drain_evt.is_set() or self.batcher.draining
+
+    def health_payload(self):
+        """(code, payload) for /healthz.  Fleet mode 503s ONLY when the
+        whole fleet is unservable; one draining replica stays 200."""
+        if self.router is None:
+            if self.draining:
+                return 503, {"status": "draining"}
+            return 200, {"status": "ok"}
+        pool = self.router.pool
+        states = pool.states()
+        # live means SERVABLE: a nominally-live replica whose worker
+        # died does not count (the router ejects it on next pick)
+        live = len(pool.live_replicas())
+        payload = {
+            "replicas": states,
+            "fleet": {
+                "size": len(states),
+                "live": live,
+                "inflight": self.router.inflight(),
+                "incumbent": pool.incumbent_id,
+            },
+        }
+        if self.delivery is not None:
+            payload["delivery"] = self.delivery.status()
+        if self.draining:
+            payload["status"] = "draining"
+            return 503, payload
+        if live == 0:
+            payload["status"] = "unservable"
+            return 503, payload
+        payload["status"] = "ok"
+        return 200, payload
 
     # ------------------------------------------------------------------
     def start(self) -> None:
@@ -233,18 +307,31 @@ class ServeServer:
         """Flip health to 503 + stop admissions; in-flight and queued
         requests still complete."""
         self._drain_evt.set()
-        self.batcher.drain()
+        if self.router is not None:
+            self.router.initiate_drain()
+        else:
+            self.batcher.drain()
+
+    def _queue_depth(self) -> int:
+        if self.router is not None:
+            return self.router.queue_depth()
+        return self.batcher.queue_depth()
 
     def shutdown(self, drain_timeout_s: float = 30.0) -> None:
-        """Drain the queue, stop the batcher worker, close the listener."""
+        """Drain the queue(s), stop the worker(s), close the listener."""
         self.initiate_drain()
         deadline = time.perf_counter() + drain_timeout_s
         while (
-            self.batcher.queue_depth() > 0
+            self._queue_depth() > 0
             and time.perf_counter() < deadline
         ):
             time.sleep(0.02)
-        self.batcher.stop(drain=True, timeout=drain_timeout_s)
+        if self.delivery is not None:
+            self.delivery.stop()
+        if self.router is not None:
+            self.router.close()
+        else:
+            self.batcher.stop(drain=True, timeout=drain_timeout_s)
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._serve_thread is not None:

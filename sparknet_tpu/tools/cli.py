@@ -121,6 +121,11 @@ def cmd_train(args) -> int:
         )
         return 1
 
+    # --publish_to implies the health sentry: a publish carries the
+    # sentry's verdict, and an unaudited run has no verdict to attach
+    if args.publish_to and not args.health and not args.health_policy:
+        args.health = "warn"
+
     # telemetry first, so restore/snapshot spans and the /metrics
     # sidecar cover the whole run (both flags off -> pure no-op)
     from sparknet_tpu import obs
@@ -369,6 +374,26 @@ def _cmd_train(args) -> int:
             paths = ckpt.wait()
             if paths:
                 log.log(f"final async snapshot: {paths[0]}")
+    if args.publish_to:
+        # train-to-serve delivery (serve/publish.py): the final state
+        # publishes ONLY with a passing sentry verdict attached to its
+        # CRC manifest — the delivery watcher (cli serve --watch)
+        # re-verifies both before any canary sees traffic.  A SentryHalt
+        # never reaches here: condemned weights are never published.
+        from sparknet_tpu.serve import publish as publish_mod
+
+        verdict = publish_mod.verdict_from_sentry(sentry)
+        try:
+            paths = publish_mod.publish_snapshot(
+                solver, state, args.publish_to, verdict
+            )
+        except publish_mod.PublishRefused as e:
+            print(f"train: {e}", file=sys.stderr)
+            return 1
+        log.log(
+            f"published verified snapshot {paths[0]} -> "
+            f"{args.publish_to} (verdict: {verdict['reason']})"
+        )
     return 0
 
 
@@ -642,13 +667,24 @@ def cmd_classify(args) -> int:
 
 def cmd_serve(args) -> int:
     """``serve --net D.prototxt|zoo-name [--weights W] [--port P]
-    [--buckets 1,4,16,64] [--max_wait_ms 2] [--queue 256]`` — run the
+    [--buckets 1,4,16,64] [--max_wait_ms 2] [--queue 256]
+    [--replicas N] [--watch PUBLISH_DIR] [--canary_frac F]`` — run the
     inference serving front-end (``sparknet_tpu/serve/``): jitted
     forward pre-compiled per batch bucket, dynamic micro-batching,
-    ``/predict`` + ``/healthz`` + ``/metrics``, SIGTERM graceful
-    drain."""
-    from sparknet_tpu import config, models
-    from sparknet_tpu.serve import InferenceEngine, ServeServer
+    ``/predict`` + ``/healthz`` + ``/metrics``, SIGTERM graceful drain.
+    ``--replicas N`` serves a fleet (``serve/fleet.py``): N
+    shared-nothing replicas behind a load-shedding router;
+    ``--watch`` adds the delivery controller (``serve/delivery.py``)
+    canarying snapshots that ``cli train --publish_to`` publishes
+    there, promoting or rolling back with no restart."""
+    from sparknet_tpu import config, models, obs
+    from sparknet_tpu.serve import (
+        DeliveryController,
+        InferenceEngine,
+        ReplicaPool,
+        Router,
+        ServeServer,
+    )
 
     netp = (
         config.load_net_prototxt(args.net)
@@ -656,27 +692,78 @@ def cmd_serve(args) -> int:
         else models.load_model(args.net)
     )
     buckets = [int(b) for b in args.buckets.split(",") if b.strip()]
-    engine = InferenceEngine(
-        netp,
-        weights=args.weights,
-        buckets=buckets,
-        output_blob=args.output_blob,
-        compute_dtype=args.dtype or None,
-    )
-    n = engine.warmup()
-    print(
-        f"serve: warmed {n} bucket programs {engine.buckets} for "
-        f"input {engine.item_shape}, output blob {engine.output_blob!r}"
-    )
-    server = ServeServer(
-        engine,
-        host=args.host,
-        port=args.port,
-        max_queue=args.queue,
-        max_wait_ms=args.max_wait_ms,
-        verbose=args.verbose,
-    )
-    return server.run()
+
+    def make_engine(weights=None):
+        return InferenceEngine(
+            netp,
+            weights=weights if weights is not None else args.weights,
+            buckets=buckets,
+            output_blob=args.output_blob,
+            compute_dtype=args.dtype or None,
+        )
+
+    # telemetry (--obs/--ship_to/...): the fleet registers its series on
+    # the shared training registry so the PR-10 shipper ships the
+    # per-replica/fleet autoscaling signals unchanged
+    run_obs = obs.start_from_args(args)
+    delivery = None
+    try:
+        if args.replicas > 1 or args.watch:
+            tm = obs.training_metrics()
+            pool = ReplicaPool(
+                make_engine,
+                replicas=args.replicas,
+                max_queue=args.queue,
+                max_wait_ms=args.max_wait_ms,
+                registry=tm.registry if tm is not None else None,
+            )
+            router = Router(
+                pool, max_inflight=args.queue,
+                canary_frac=args.canary_frac,
+            )
+            print(
+                "serve: fleet of %d replica(s) warmed (%d bucket "
+                "programs each: %s), input %s"
+                % (
+                    len(pool.replicas), len(buckets), buckets,
+                    pool.item_shape,
+                )
+            )
+            if args.watch:
+                delivery = DeliveryController(
+                    pool, router, args.watch,
+                    cache_dir=args.cache_dir,
+                    decision_requests=args.decision_requests,
+                    divergence_max=args.divergence_max,
+                    echo=print,
+                ).start()
+                print(f"serve: delivery watcher on {args.watch}")
+            server = ServeServer(
+                router=router,
+                delivery=delivery,
+                host=args.host,
+                port=args.port,
+                verbose=args.verbose,
+            )
+        else:
+            engine = make_engine()
+            n = engine.warmup()
+            print(
+                f"serve: warmed {n} bucket programs {engine.buckets} "
+                f"for input {engine.item_shape}, output blob "
+                f"{engine.output_blob!r}"
+            )
+            server = ServeServer(
+                engine,
+                host=args.host,
+                port=args.port,
+                max_queue=args.queue,
+                max_wait_ms=args.max_wait_ms,
+                verbose=args.verbose,
+            )
+        return server.run()
+    finally:
+        run_obs.close()
 
 
 def cmd_parse_log(args) -> int:
@@ -929,6 +1016,14 @@ def main(argv=None) -> int:
     p.add_argument(
         "--sighup_effect", choices=["stop", "snapshot", "none"], default="snapshot"
     )
+    p.add_argument(
+        "--publish_to", default=None, metavar="DIR",
+        help="publish the final state here for a serving fleet "
+        "(serve/publish.py): a CRC-manifested snapshot with the health "
+        "sentry's PASSING verdict attached — a diverged run publishes "
+        "nothing.  Implies --health warn.  Serve side: "
+        "cli serve --watch DIR canaries + promotes it with no restart",
+    )
     from sparknet_tpu import obs as _obs
     from sparknet_tpu.parallel import comm as _comm
 
@@ -999,6 +1094,29 @@ def main(argv=None) -> int:
                    help="compute dtype, e.g. bfloat16 (default f32)")
     p.add_argument("--verbose", action="store_true",
                    help="log each HTTP request")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="N>1: a serving FLEET (serve/fleet.py) — N "
+                   "shared-nothing engine replicas behind a router "
+                   "that load-balances by in-flight depth and sheds "
+                   "(429) at a fleet-wide admission bound (--queue)")
+    p.add_argument("--watch", default=None, metavar="PUBLISH_DIR",
+                   help="watch this publish location (local dir or "
+                   "object-store url) for cli train --publish_to "
+                   "snapshots: CRC+verdict verify, warm a standby "
+                   "off-path, canary live traffic, promote or roll "
+                   "back with no restart (serve/delivery.py)")
+    p.add_argument("--canary_frac", type=float, default=0.125,
+                   help="fraction of live traffic mirrored to a canary "
+                   "during a delivery decision window")
+    p.add_argument("--decision_requests", type=int, default=32,
+                   help="mirrored requests per canary decision window")
+    p.add_argument("--divergence_max", type=float, default=0.25,
+                   help="max |canary - incumbent| output divergence "
+                   "before the canary rolls back")
+    p.add_argument("--cache_dir", default=None,
+                   help="chunk-cache root for the delivery watcher's "
+                   "verified snapshot staging (default: a temp dir)")
+    _obs.add_cli_args(p)  # --obs/--ship_to/...: fleet series ride the shipper
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("parse_log")

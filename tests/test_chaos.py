@@ -5,7 +5,7 @@ preemption with simulated process death, newest-snapshot corruption
 quarantined + fallback restore, and a dead dp worker masked out of the
 average — and requires every injected fault survived plus a final loss
 inside the no-fault baseline's band (the acceptance bar for
-``CHAOS_r14.json``)."""
+``CHAOS_r15.json``)."""
 
 import dataclasses
 import os
@@ -47,6 +47,14 @@ def test_default_plan_covers_every_fault_class():
     assert plan.cache_corrupt_round < plan.preempt_round
     assert plan.cache_cold_round is not None
     assert plan.cache_cold_round > plan.preempt_round
+    # the serving-fleet faults (round 15): both fire AFTER the
+    # preemption (the fleet is rebuilt lazily on the resumed process —
+    # the realistic case), and the corrupt publish comes after the
+    # replica death so the rejection runs against a healed fleet
+    assert plan.replica_death_round is not None
+    assert plan.replica_death_round > plan.preempt_round
+    assert plan.publish_corrupt_round is not None
+    assert plan.publish_corrupt_round > plan.replica_death_round
 
 
 def test_no_fault_view_strips_all_faults():
@@ -57,6 +65,8 @@ def test_no_fault_view_strips_all_faults():
     assert base.straggler_round is None
     assert base.cache_corrupt_round is None
     assert base.cache_cold_round is None
+    assert base.replica_death_round is None
+    assert base.publish_corrupt_round is None
     # run geometry unchanged: the baseline is comparable
     plan = chaos.FaultPlan.default()
     for f in ("seed", "workers", "rounds", "tau", "batch"):
@@ -202,6 +212,17 @@ def test_chaos_smoke_default_plan(tmp_path):
     assert any(
         f.endswith(".corrupt") for f in os.listdir(cache_dir)
     ), "quarantined cache entry must stay on disk for forensics"
+
+    # the serving-fleet faults (round 15): the dead replica was
+    # ejected + respawned with zero client errors, and the corrupt
+    # publish was rejected at CRC verify and quarantined in the
+    # publish dir (it never reached a canary)
+    assert rep["faults"]["replica_death"]["survived"] == 1
+    assert rep["faults"]["published_snapshot_corrupt"]["survived"] == 1
+    pub_dir = os.path.join(str(tmp_path), "publish")
+    assert any(
+        f.endswith(".corrupt") for f in os.listdir(pub_dir)
+    ), "rejected publish must be quarantined on disk"
 
     # quarantined files really are on disk, out of the resume scan
     corrupt = [f for f in os.listdir(str(tmp_path)) if f.endswith(".corrupt")]

@@ -230,6 +230,48 @@ def test_fleet_family_rules(tmp_path):
         ), (bad_field, rows)
 
 
+GOOD_DELIVERY = {
+    "value": 180.0, "scaling_ratio_modeled": 1.45,
+    "shed_invariant_ok": True, "promote_ok": True,
+    "promote_dropped_inflight": 0, "promote_bit_identical": True,
+    "rollback_exact": True, "rollback_dropped_inflight": 0,
+    "incumbent_held_after_rollback": True, "replica_kill_ok": True,
+    "replica_kill_client_errors": 0,
+}
+
+
+def test_delivery_family_rules(tmp_path):
+    """The DELIVERY family (ISSUE 12): modeled fleet scaling, the
+    shed-invariance contract, zero-drop promotes with bit identity,
+    exact-named rollbacks, and replica-kill recovery — any one
+    regressing fails --check."""
+    g = _gate()
+    _write(tmp_path, "DELIVERY_r15.json", GOOD_DELIVERY)
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 0, rows
+    for bad_field, bad_value in (
+        ("scaling_ratio_modeled", 1.0),       # fleet didn't scale
+        ("shed_invariant_ok", False),         # admission bound drifted
+        ("promote_ok", False),                # wrong snapshot promoted
+        ("promote_dropped_inflight", 3),      # promote dropped requests
+        ("promote_bit_identical", False),     # reload changed outputs
+        ("rollback_exact", False),            # wrong publish named
+        ("rollback_dropped_inflight", 2),     # rollback dropped requests
+        ("incumbent_held_after_rollback", False),
+        ("replica_kill_ok", False),           # kill not recovered
+        ("replica_kill_client_errors", 1),    # kill leaked client errors
+    ):
+        _write(
+            tmp_path, "DELIVERY_r16.json",
+            dict(GOOD_DELIVERY, **{bad_field: bad_value}),
+        )
+        rc, rows = g.check(str(tmp_path))
+        assert rc == 1, bad_field
+        assert any(
+            bad_field in r["detail"] for r in rows if not r["ok"]
+        ), (bad_field, rows)
+
+
 def test_missing_key_is_a_failure_not_a_pass(tmp_path):
     g = _gate()
     _write(tmp_path, "OBS_r09.json", {"overhead_traced_pct": 0.5})

@@ -168,6 +168,30 @@ RULES: Dict[str, List[Rule]] = {
         Rule("outage_lost_events", "==", 0),
         Rule("outage_dropped_events", "==", 0),
     ],
+    "DELIVERY": [
+        # the serving fleet + train-to-serve contract (bench.py
+        # --mode=delivery): fleet throughput scales with replicas under
+        # the modeled per-replica device cost (the real-engine leg is
+        # disclosed, not gated — a 1-core box measures CPU contention,
+        # not fleet design), the fleet-wide 429 shed count is invariant
+        # in the replica count at fixed offered load, a good publish
+        # promotes with ZERO dropped in-flight requests and
+        # bit-identical outputs, the seeded-bad publish rolls back
+        # named at exactly the injected publish with the incumbent
+        # held, and a mid-traffic replica kill ejects + respawns with
+        # zero client errors
+        Rule("value", ">", 0),
+        Rule("scaling_ratio_modeled", ">", 1.2),
+        Rule("shed_invariant_ok", "is", True),
+        Rule("promote_ok", "is", True),
+        Rule("promote_dropped_inflight", "==", 0),
+        Rule("promote_bit_identical", "is", True),
+        Rule("rollback_exact", "is", True),
+        Rule("rollback_dropped_inflight", "==", 0),
+        Rule("incumbent_held_after_rollback", "is", True),
+        Rule("replica_kill_ok", "is", True),
+        Rule("replica_kill_client_errors", "==", 0),
+    ],
     "DATACACHE": [
         # the I/O-flat contract: a warm (cache-filled, shuffled-
         # assignment) epoch makes ZERO network fetches and is strictly
