@@ -148,6 +148,25 @@ Modes (env):
                         client errors (DELIVERY_r15.json artifact;
                         gated by tools/perf_gate.py --check)
 
+  BENCH_MODE=elastic    elastic membership + two-tier hierarchical
+                        averaging proof (runtime/membership.py +
+                        parallel/hierarchy.py): a flat HierarchySpec's
+                        round pinned BIT-IDENTICAL to today's
+                        single-tier round; a REAL SIGTERM preemption
+                        notice for a whole slice — views advance
+                        leave -> dead -> rejoin with monotonic epochs,
+                        the departure lands at exactly the next round
+                        boundary, the average renormalizes over
+                        survivors every intervening round, the
+                        relaunched slice readmits via snapshot ->
+                        restore_newest_valid -> broadcast_state
+                        (momentum zeroed) and the final loss sits in
+                        the no-fault band; and the two-tier schedule's
+                        cross-slice collective bytes measured ~K x
+                        lower than an every-round flat run
+                        (ELASTIC_r16.json artifact; gated by
+                        tools/perf_gate.py --check)
+
 Modes can also be selected as ``python bench.py --mode=serve`` (flag
 wins over the env var); an unknown mode is rejected.
   BENCH_PROFILE=1       also print the `caffe time`-style per-layer table
@@ -170,6 +189,7 @@ if _REPO not in sys.path:
 _MODES = (
     "train", "hostfeed", "scaling", "serve", "chaos", "pipeline", "obs",
     "health", "profile", "datacache", "sanitize", "fleet", "delivery",
+    "elastic",
 )
 _MODE = os.environ.get("BENCH_MODE", "train")
 for _i, _a in enumerate(sys.argv[1:], start=1):
@@ -188,7 +208,7 @@ if _MODE not in _MODES:
         % (_MODE, "|".join(_MODES))
     )
 if _MODE in ("scaling", "chaos", "pipeline", "obs", "health", "profile",
-             "sanitize", "fleet"):
+             "sanitize", "fleet", "elastic"):
     # these modes need >1 device; on a 1-chip host force the virtual CPU
     # mesh (the driver's multichip validation environment).  This must run
     # BEFORE the first backend use (XLA_FLAGS is parsed once per process),
@@ -3120,6 +3140,307 @@ def bench_fleet():
     print(json.dumps(out))
 
 
+def bench_elastic():
+    """Elastic membership + two-tier hierarchical averaging proof
+    (``runtime/membership.py`` + ``parallel/hierarchy.py``).
+
+    Three legs:
+
+    1. **flat-spec bit-identity** — a trainer given
+       ``HierarchySpec.flat`` (and one given a multi-slice grouping
+       with K=1) must produce TrainStates BITWISE identical to a
+       hierarchy-less trainer over the same seeded rounds (the
+       PR-3/PR-5 identity-pin style).
+    2. **slice preemption e2e** — a two-tier run receives a REAL
+       SIGTERM preemption notice for slice 1 mid-run: the membership
+       view must advance at EXACTLY the next round boundary
+       (leave -> dead, monotonic epochs), every intervening round's
+       average must renormalize over the surviving slice, the
+       relaunched slice must readmit via a fresh consensus snapshot ->
+       ``restore_newest_valid`` -> ``broadcast_state`` with momentum
+       zeroed, and the final loss must land inside the no-fault run's
+       band.
+    3. **two-tier cross-slice bytes** — the same model trained under
+       an every-round-flat schedule (K=1) vs the two-tier schedule
+       (K=BENCH_CROSS_EVERY): the measured cross-slice collective
+       bytes (``sparknet_hierarchy_bytes_total{tier="cross"}``) must
+       drop ~K x.
+    """
+    import signal as _signal
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from sparknet_tpu import config as cfg, models, obs
+    from sparknet_tpu.data import CifarLoader
+    from sparknet_tpu.parallel import (
+        HierarchySpec,
+        ParameterAveragingTrainer,
+        make_mesh,
+        shard_leading,
+    )
+    from sparknet_tpu.runtime import membership as membership_mod
+    from sparknet_tpu.solver import Solver
+    from sparknet_tpu.utils.signals import SignalHandler, SolverAction
+
+    workers = int(os.environ.get("BENCH_WORKERS", "4"))
+    tau = int(os.environ.get("BENCH_TAU", "2"))
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    rounds = int(os.environ.get("BENCH_ELASTIC_ROUNDS", "10"))
+    K = int(os.environ.get("BENCH_CROSS_EVERY", "4"))
+    byte_rounds = int(os.environ.get("BENCH_BYTE_ROUNDS", str(2 * K)))
+    preempt_round = int(os.environ.get("BENCH_PREEMPT_ROUND", "3"))
+    relaunch_delta = 2
+    seed = 7
+
+    workdir = tempfile.mkdtemp(prefix="bench_elastic_")
+    data_dir = os.path.join(workdir, "data")
+    CifarLoader.write_synthetic(
+        data_dir, num_train=512, num_test=64, seed=seed
+    )
+    xs, ys = CifarLoader(data_dir).minibatches(batch, train=True)
+
+    def window(r):
+        n = len(xs)
+        data = np.empty((workers, tau) + xs[0].shape, np.float32)
+        label = np.empty((workers, tau, batch), np.float32)
+        for w in range(workers):
+            for t in range(tau):
+                i = (r * workers * tau + w * tau + t) % n
+                data[w, t] = xs[i]
+                label[w, t] = ys[i]
+        return {"data": data, "label": label}
+
+    netp = cfg.replace_data_layers(
+        models.load_model("cifar10_quick"),
+        [(batch, 3, 32, 32), (batch,)],
+        [(batch, 3, 32, 32), (batch,)],
+    )
+    mesh = make_mesh({"dp": workers}, devices=jax.devices()[:workers])
+    tm = obs.enable_training_metrics()  # the measured byte counters
+
+    def build(spec):
+        solver = Solver(
+            models.load_model_solver("cifar10_quick"), net_param=netp
+        )
+        return solver, ParameterAveragingTrainer(
+            solver, mesh, hierarchy=spec
+        )
+
+    def run(trainer, n):
+        # the unfaulted round loop (legs 1 + 3 and the leg-2 baseline);
+        # the preemption leg below drives its own loop with the
+        # membership mask + SIGTERM schedule
+        state = trainer.init_state(seed=seed)
+        losses = None
+        for r in range(n):
+            state, losses = trainer.round(
+                state, shard_leading(window(r), mesh), round_index=r,
+            )
+        return state, float(np.mean(np.asarray(jax.device_get(losses))))
+
+    # ---- leg 1: flat-spec bit-identity -----------------------------
+    ident_rounds = 3
+    _, t_none = build(None)
+    _, t_flat = build(HierarchySpec.flat(workers))
+    _, t_k1 = build(HierarchySpec.grouped(workers, 2, 1))
+    st_none, _ = run(t_none, ident_rounds)
+    st_flat, _ = run(t_flat, ident_rounds)
+    st_k1, _ = run(t_k1, ident_rounds)
+    flat_bit_identical = True
+    for ref, other in ((st_none, st_flat), (st_none, st_k1)):
+        for a, b in zip(
+            jax.tree_util.tree_leaves(jax.device_get(ref)),
+            jax.tree_util.tree_leaves(jax.device_get(other)),
+        ):
+            if not np.array_equal(np.asarray(a), np.asarray(b)):
+                flat_bit_identical = False
+    print(
+        "elastic: flat-spec round bit-identical to single-tier: %s "
+        "(%d rounds, flat + K=1 variants)"
+        % (flat_bit_identical, ident_rounds),
+        file=sys.stderr,
+    )
+
+    # ---- leg 2: slice preemption, leave -> rejoin ------------------
+    spec = HierarchySpec.grouped(workers, 2, 2)
+    _, t_base = build(spec)
+    _, baseline_loss = run(t_base, rounds)
+
+    solver_f, t_fault = build(spec)
+    ctl = membership_mod.MembershipController(spec, echo=None)
+    ctl.sigterm_marks(1)  # the preempted slice
+    prefix = os.path.join(workdir, "elastic_ckpt")
+    masked_rounds = []
+    leave_round = {"r": None}
+    rejoin_round = {"r": None}
+
+    def mask_for(r):
+        view = ctl.advance(r)
+        if ctl.pending_joiners():
+            nonlocal_state["st"], _ = membership_mod.readmit(
+                t_fault, solver_f, nonlocal_state["st"], prefix, ctl, r,
+                snapshot_fmt="BINARYPROTO",
+            )
+            rejoin_round["r"] = r
+            view = ctl.view
+        mask = view.live_mask()
+        if (
+            leave_round["r"] is None
+            and any(s != membership_mod.LIVE for s in view.states)
+        ):
+            leave_round["r"] = r
+        if all(mask[w] == 0.0 for w in spec.slices[1]):
+            masked_rounds.append(r)
+        return mask
+
+    def on_round_end(r, state):
+        if r == preempt_round:
+            # the orchestrator's preemption notice, for real
+            os.kill(os.getpid(), _signal.SIGTERM)
+        if r == preempt_round + relaunch_delta:
+            ctl.note_join(spec.slices[1])
+        return state
+
+    nonlocal_state = {"st": t_fault.init_state(seed=seed)}
+    with SignalHandler(
+        sigint_effect=SolverAction.NONE,
+        sighup_effect=SolverAction.NONE,
+        sigterm_hooks=True,
+    ):
+        losses = None
+        for r in range(rounds):
+            mask = mask_for(r)
+            nonlocal_state["st"], losses = t_fault.round(
+                nonlocal_state["st"], shard_leading(window(r), mesh),
+                live_mask=mask, round_index=r,
+            )
+            on_round_end(r, None)
+    ctl.detach()
+    faulted_loss = float(np.mean(np.asarray(jax.device_get(losses))))
+    loss_band = max(0.25, 0.25 * abs(baseline_loss))
+    loss_band_ok = bool(abs(faulted_loss - baseline_loss) <= loss_band)
+    departure_exact = leave_round["r"] == preempt_round + 1
+    rejoin_completed = bool(
+        rejoin_round["r"] is not None
+        and all(s == membership_mod.LIVE for s in ctl.view.states)
+    )
+    views_monotonic = ctl.epochs_monotonic()
+    print(
+        "elastic: preempted slice 1 at round %d -> left at %s, masked "
+        "rounds %s, rejoined at %s (epoch %d) | loss %.4f vs no-fault "
+        "%.4f (band +/-%.3f: %s)"
+        % (
+            preempt_round, leave_round["r"], masked_rounds,
+            rejoin_round["r"], ctl.epoch, faulted_loss, baseline_loss,
+            loss_band, "OK" if loss_band_ok else "OUT",
+        ),
+        file=sys.stderr,
+    )
+
+    # ---- leg 3: measured cross-slice bytes, flat vs two-tier -------
+    def cross_bytes(run_fn):
+        before = (
+            tm.hierarchy_bytes.labels("cross").value,
+            tm.hierarchy_bytes.labels("intra").value,
+        )
+        t0 = time.perf_counter()
+        run_fn()
+        wall = time.perf_counter() - t0
+        return (
+            tm.hierarchy_bytes.labels("cross").value - before[0],
+            tm.hierarchy_bytes.labels("intra").value - before[1],
+            wall,
+        )
+
+    _, t_flat_sched = build(HierarchySpec.grouped(workers, 2, 1))
+    _, t_two_tier = build(HierarchySpec.grouped(workers, 2, K))
+    flat_state = {}
+    two_state = {}
+    cross_flat, intra_flat, wall_flat = cross_bytes(
+        lambda: flat_state.update(
+            out=run(t_flat_sched, byte_rounds)
+        )
+    )
+    cross_two, intra_two, wall_two = cross_bytes(
+        lambda: two_state.update(out=run(t_two_tier, byte_rounds))
+    )
+    ratio = cross_flat / cross_two if cross_two else float("inf")
+    flat_loss = flat_state["out"][1]
+    two_loss = two_state["out"][1]
+    print(
+        "elastic: %d rounds, cross-slice bytes %.1f MB flat (K=1) vs "
+        "%.1f MB two-tier (K=%d) -> %.2fx fewer | intra %.1f/%.1f MB "
+        "| loss %.4f vs %.4f"
+        % (
+            byte_rounds, cross_flat / 1e6, cross_two / 1e6, K, ratio,
+            intra_flat / 1e6, intra_two / 1e6, flat_loss, two_loss,
+        ),
+        file=sys.stderr,
+    )
+
+    out = {
+        "metric": "elastic_cross_slice_bytes_ratio",
+        "value": round(ratio, 3),
+        # done-bar: ~K x fewer cross-slice (DCN) bytes under two-tier
+        "vs_baseline": round(round(ratio, 3) / K, 3),
+        "unit": "x fewer cross-slice bytes vs every-round flat",
+        "platform": jax.devices()[0].platform,
+        "workers": workers,
+        "tau": tau,
+        "batch": batch,
+        "rounds": rounds,
+        "slices": spec.num_slices,
+        "cross_slice_every": K,
+        "flat_bit_identical": flat_bit_identical,
+        "flat_identity_rounds": ident_rounds,
+        "preempt_round": preempt_round,
+        "departure_detected_round": leave_round["r"],
+        "departure_detected_exact": bool(departure_exact),
+        "slice_masked_rounds": masked_rounds,
+        "rejoin_round": rejoin_round["r"],
+        "rejoin_completed": rejoin_completed,
+        "views_monotonic": bool(views_monotonic),
+        "membership_epochs": ctl.epoch,
+        "membership_transitions": [
+            [e, r, k, list(ws)] for e, r, k, ws in ctl.transitions
+        ],
+        "final_loss": round(faulted_loss, 4),
+        "baseline_final_loss": round(baseline_loss, 4),
+        "loss_band": round(loss_band, 4),
+        "loss_band_ok": loss_band_ok,
+        "byte_rounds": byte_rounds,
+        "cross_bytes_flat": int(cross_flat),
+        "cross_bytes_two_tier": int(cross_two),
+        "cross_bytes_ratio": round(ratio, 3),
+        "intra_bytes_flat": int(intra_flat),
+        "intra_bytes_two_tier": int(intra_two),
+        "flat_sched_final_loss": round(flat_loss, 4),
+        "two_tier_final_loss": round(two_loss, 4),
+        "flat_sched_wall_s": round(wall_flat, 3),
+        "two_tier_wall_s": round(wall_two, 3),
+        "note": "leg 1 pins a flat HierarchySpec (and a 2-slice K=1 "
+        "grouping) BITWISE identical to the hierarchy-less trainer "
+        "over seeded rounds — flat specs run the same jitted program "
+        "by construction.  Leg 2 delivers a REAL SIGTERM as the "
+        "preemption notice for slice 1 of a two-tier (2-slice, K=2) "
+        "cifar10_quick run: the membership view advances at exactly "
+        "the next round boundary, the departed slice is excluded "
+        "(masked weighted mean) every intervening round, and the "
+        "relaunched slice readmits via consensus snapshot -> "
+        "restore_newest_valid -> broadcast_state with momentum "
+        "zeroed; final loss within the no-fault band.  Leg 3 measures "
+        "sparknet_hierarchy_bytes_total{tier}: the bytes are the "
+        "MODELED ring payload (the virtual CPU mesh moves shared-"
+        "memory copies — the PERF.md modeled-bytes convention), so "
+        "the K x reduction is exact: cross-slice rounds happen 1/K "
+        "as often.  Wall-clock deltas on this box are noise (the CPU "
+        "mesh pays no DCN cost); the byte counters are the claim.",
+    }
+    print(json.dumps(out))
+
+
 def bench_delivery():
     """Serving fleet + train-to-serve delivery proof (ISSUE 12
     acceptance; ``serve/fleet.py`` + ``serve/delivery.py``).
@@ -3608,6 +3929,9 @@ def main():
         return
     if _MODE == "delivery":
         bench_delivery()
+        return
+    if _MODE == "elastic":
+        bench_elastic()
         return
     # the remote-TPU tunnel occasionally drops a request mid-run; one
     # retry keeps the recorded benchmark from dying on a transient

@@ -55,6 +55,15 @@ CANONICAL_METRICS = {
     "sparknet_update_ratio": ("group",),
     "sparknet_health_anomalies_total": ("kind",),
     "sparknet_health_rollbacks_total": (),
+    # elastic membership (runtime/membership.py, --elastic) — the
+    # epoch-numbered worker-roster views driving the round's live_mask
+    "sparknet_membership_epoch": (),
+    "sparknet_membership_workers": ("state",),
+    "sparknet_membership_transitions_total": ("kind",),
+    # two-tier hierarchical averaging (parallel/hierarchy.py,
+    # --slices/--cross_slice_every) — tier-split round/byte accounting
+    "sparknet_hierarchy_rounds_total": ("tier",),
+    "sparknet_hierarchy_bytes_total": ("tier",),
     # fleet shipper (obs/ship.py, --ship_to) — per-host push side
     "sparknet_ship_events_total": (),
     "sparknet_ship_dropped_total": (),

@@ -40,10 +40,11 @@ def main(argv=None) -> int:
         "transfers collapse throughput (PERF.md)",
     )
     from sparknet_tpu import obs
-    from sparknet_tpu.parallel import comm
+    from sparknet_tpu.parallel import comm, hierarchy
 
     obs.add_cli_args(parser)  # --obs / --obs_port / --trace_out
     comm.add_cli_args(parser)  # --compress / --overlap_avg
+    hierarchy.add_cli_args(parser)  # --slices/--cross_slice_every/--elastic
     args = parser.parse_args(argv)
 
     import jax
@@ -127,10 +128,45 @@ def main(argv=None) -> int:
 
     sentry = health_mod.sentry_from_args(args, solver, echo=log.log)
     # --compress/--overlap_avg: comm-plane averaging (delta-quantized,
-    # chunked, optionally overlapped — parallel/comm.py)
+    # chunked, optionally overlapped — parallel/comm.py);
+    # --slices/--cross_slice_every: two-tier hierarchical schedule
+    spec = hierarchy.spec_from_args(args, n_workers)
     trainer = ParameterAveragingTrainer(
-        solver, mesh, **comm.comm_kwargs_from_args(args)
+        solver, mesh, **comm.comm_kwargs_from_args(args), hierarchy=spec
     )
+    # --elastic: the membership controller (runtime/membership.py)
+    # maintains epoch-numbered roster views that drive each round's
+    # live_mask; a SIGTERM preemption notice marks THIS process's
+    # slice ($SPARKNET_SLICE_ID, the launcher sets it; defaults to the
+    # last slice) leaving at the next round boundary, and the departed
+    # slice rejoins from the survivor consensus (this app keeps no
+    # snapshots) --rejoin_after boundaries later — the single-process
+    # stand-in for the orchestrator's relaunch notice (AutoRejoin;
+    # external drivers use note_join / fleet views instead).
+    membership_ctl = None
+    auto_rejoin = None
+    if args.elastic:
+        import os as _os
+
+        from sparknet_tpu.runtime import membership as membership_mod
+
+        membership_ctl = membership_mod.MembershipController(
+            spec
+            if spec is not None
+            else hierarchy.HierarchySpec.flat(n_workers),
+            echo=log.log,
+        )
+        my_slice = int(
+            _os.environ.get(
+                "SPARKNET_SLICE_ID",
+                membership_ctl.spec.num_slices - 1,
+            )
+        )
+        membership_ctl.sigterm_marks(my_slice)
+        auto_rejoin = membership_mod.AutoRejoin(
+            membership_ctl, args.rejoin_after
+        )
+        obs.set_membership(membership_ctl)
     state = trainer.init_state(seed=args.seed)
     test_batches, test_counts = ParameterAveragingTrainer.pad_partitions(
         test_parts
@@ -170,21 +206,54 @@ def main(argv=None) -> int:
         pipelined=not args.serial_feed,
         num_rounds=args.rounds,
     )
+    from sparknet_tpu.utils import SignalHandler, SolverAction
+
     try:
-        for r in range(args.rounds):
-            if r % args.test_every == 0:  # test before train, CifarApp.scala:101
-                # land any in-flight overlapped average before scoring
-                state = trainer.finalize(state)
-                log.log(f"round {r}, accuracy {evaluate(r):.4f}")
-            if sentry is not None:
-                state, _ = sentry.guarded_round(
-                    trainer, state, feed.next_round(r), round_index=r
+        # the SIGTERM handler is installed only to deliver preemption
+        # notices to the membership hook; SIGINT/SIGHUP keep their
+        # default behavior (this app has no snapshot machinery)
+        with SignalHandler(
+            sigint_effect=SolverAction.NONE,
+            sighup_effect=SolverAction.NONE,
+            sigterm_hooks=membership_ctl is not None,
+        ):
+            for r in range(args.rounds):
+                if r % args.test_every == 0:  # test before train, CifarApp.scala:101
+                    # land any in-flight overlapped average before scoring
+                    state = trainer.finalize(state)
+                    log.log(f"round {r}, accuracy {evaluate(r):.4f}")
+                mask = None
+                if membership_ctl is not None:
+                    # roster changes land at the round boundary; a
+                    # relaunched slice rejoins from the survivor
+                    # consensus (momentum zeroed)
+                    membership_ctl.advance(r)
+                    auto_rejoin.on_round(r)
+                    if membership_ctl.pending_joiners():
+                        state, _ = membership_mod.readmit_from_survivors(
+                            trainer, state, membership_ctl, r,
+                            echo=log.log,
+                        )
+                    mask = membership_ctl.live_mask()
+                    if not mask.any():
+                        log.log(
+                            f"round {r}: no live workers in the "
+                            "membership view; stopping"
+                        )
+                        break
+                if sentry is not None:
+                    state, _ = sentry.guarded_round(
+                        trainer, state, feed.next_round(r),
+                        live_mask=mask, round_index=r,
+                    )
+                else:
+                    state, _ = trainer.round(
+                        state, feed.next_round(r),
+                        live_mask=mask, round_index=r,
+                    )
+                log.log(
+                    f"round {r} trained, smoothed_loss {solver.smoothed_loss:.4f}"
                 )
-            else:
-                state, _ = trainer.round(state, feed.next_round(r))
-            log.log(
-                f"round {r} trained, smoothed_loss {solver.smoothed_loss:.4f}"
-            )
         state = trainer.finalize(state)  # last round's average lands
         log.log(f"final accuracy {evaluate():.4f}")
         return 0
@@ -192,6 +261,8 @@ def main(argv=None) -> int:
         log.log(f"training halted by the health sentry: {e}")
         return 1
     finally:
+        if membership_ctl is not None:
+            membership_ctl.detach()
         feed.stop()
         run_obs.close()
         log.close()

@@ -59,10 +59,11 @@ def main(argv=None) -> int:
         "links)",
     )
     from sparknet_tpu import obs
-    from sparknet_tpu.parallel import comm
+    from sparknet_tpu.parallel import comm, hierarchy
 
     obs.add_cli_args(parser)  # --obs / --obs_port / --trace_out
     comm.add_cli_args(parser)  # --compress / --overlap_avg
+    hierarchy.add_cli_args(parser)  # --slices / --cross_slice_every / --elastic
     args = parser.parse_args(argv)
 
     import jax
@@ -117,8 +118,16 @@ def main(argv=None) -> int:
     from sparknet_tpu.obs import health as health_mod
 
     sentry = health_mod.sentry_from_args(args, solver, echo=log.log)
+    if getattr(args, "elastic", False):
+        log.log(
+            "--elastic: the membership controller is wired in "
+            "cifar_app (this app applies the --slices/"
+            "--cross_slice_every hierarchy schedule; preemption "
+            "masking rides the fleet plane)"
+        )
     trainer = ParameterAveragingTrainer(
-        solver, mesh, **comm.comm_kwargs_from_args(args)
+        solver, mesh, **comm.comm_kwargs_from_args(args),
+        **hierarchy.trainer_kwargs_from_args(args, args.workers),
     )
     state = trainer.init_state(seed=args.seed)
     log.log("nets ready")
@@ -154,7 +163,9 @@ def main(argv=None) -> int:
                     trainer, state, feed.next_round(r), round_index=r
                 )
             else:
-                state, _ = trainer.round(state, feed.next_round(r))
+                state, _ = trainer.round(
+                    state, feed.next_round(r), round_index=r
+                )
             log.log(
                 f"round {r} trained, smoothed_loss {solver.smoothed_loss:.4f}"
             )

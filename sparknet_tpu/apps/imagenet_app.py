@@ -113,10 +113,11 @@ def main(argv=None) -> int:
         "behavior)",
     )
     from sparknet_tpu import obs
-    from sparknet_tpu.parallel import comm
+    from sparknet_tpu.parallel import comm, hierarchy
 
     obs.add_cli_args(parser)  # --obs / --obs_port / --trace_out
     comm.add_cli_args(parser)  # --compress / --overlap_avg
+    hierarchy.add_cli_args(parser)  # --slices / --cross_slice_every / --elastic
     args = parser.parse_args(argv)
 
     import jax
@@ -361,8 +362,16 @@ def main(argv=None) -> int:
     from sparknet_tpu.obs import health as health_mod
 
     sentry = health_mod.sentry_from_args(args, solver, echo=log.log)
+    if getattr(args, "elastic", False):
+        log.log(
+            "--elastic: the membership controller is wired in "
+            "cifar_app (this app applies the --slices/"
+            "--cross_slice_every hierarchy schedule; preemption "
+            "masking rides the fleet plane)"
+        )
     trainer = ParameterAveragingTrainer(
-        solver, mesh, **comm.comm_kwargs_from_args(args)
+        solver, mesh, **comm.comm_kwargs_from_args(args),
+        **hierarchy.trainer_kwargs_from_args(args, n_workers),
     )
     state = trainer.init_state(seed=args.seed)
     test_on_dev = shard_leading_global(test_batches, mesh)
@@ -433,7 +442,9 @@ def main(argv=None) -> int:
                     trainer, state, feed.next_round(r), round_index=r
                 )
             else:
-                state, _ = trainer.round(state, feed.next_round(r))
+                state, _ = trainer.round(
+                    state, feed.next_round(r), round_index=r
+                )
             log.log(
                 f"trained, smoothed_loss {solver.smoothed_loss:.4f}", i=r
             )

@@ -78,10 +78,11 @@ def main(argv=None) -> int:
         "boundaries)",
     )
     from sparknet_tpu import obs
-    from sparknet_tpu.parallel import comm
+    from sparknet_tpu.parallel import comm, hierarchy
 
     obs.add_cli_args(parser)  # --obs / --obs_port / --trace_out
     comm.add_cli_args(parser)  # --compress / --overlap_avg
+    hierarchy.add_cli_args(parser)  # --slices / --cross_slice_every / --elastic
     args = parser.parse_args(argv)
 
     import jax
@@ -203,8 +204,16 @@ def main(argv=None) -> int:
 
     sentry = health_mod.sentry_from_args(args, solver, echo=log.log)
     mesh = make_mesh({"dp": n_workers}, devices=jax.devices()[:n_workers])
+    if getattr(args, "elastic", False):
+        log.log(
+            "--elastic: the membership controller is wired in "
+            "cifar_app (this app applies the --slices/"
+            "--cross_slice_every hierarchy schedule; preemption "
+            "masking rides the fleet plane)"
+        )
     trainer = ParameterAveragingTrainer(
-        solver, mesh, **comm.comm_kwargs_from_args(args)
+        solver, mesh, **comm.comm_kwargs_from_args(args),
+        **hierarchy.trainer_kwargs_from_args(args, n_workers),
     )
     state = trainer.init_state(seed=args.seed)
 
@@ -320,7 +329,9 @@ def main(argv=None) -> int:
                     trainer, state, feed.next_round(r), round_index=r
                 )
             else:
-                state, _ = trainer.round(state, feed.next_round(r))
+                state, _ = trainer.round(
+                    state, feed.next_round(r), round_index=r
+                )
             log.log(f"trained, smoothed_loss {solver.smoothed_loss:.4f}", i=r)
             if args.snapshot_every and (r + 1) % args.snapshot_every == 0:
                 # a snapshot must capture the round's AVERAGE, not a

@@ -298,6 +298,42 @@ class TrainingMetrics:
             "sparknet_health_rollbacks_total",
             "sentry-triggered rollbacks to a verified snapshot",
         )
+        # elastic-membership series (runtime/membership.py, --elastic)
+        # — zero until a run arms the membership controller
+        self.membership_epoch = registry.gauge(
+            "sparknet_membership_epoch",
+            "current membership view epoch (bumps once per roster "
+            "change applied at a round boundary)",
+        )
+        self.membership_workers = registry.gauge(
+            "sparknet_membership_workers",
+            "dp workers per membership state (live carry mask weight; "
+            "leaving/dead/joining are excluded from the average)",
+            labels=("state",),
+        )
+        self.membership_transitions = registry.counter(
+            "sparknet_membership_transitions_total",
+            "membership state transitions applied at round boundaries, "
+            "by kind (leave/late/death/join_request/rejoin)",
+            labels=("kind",),
+        )
+        # two-tier hierarchical averaging series (parallel/hierarchy.py,
+        # --slices/--cross_slice_every) — zero on flat (single-tier)
+        # runs
+        self.hierarchy_rounds = registry.counter(
+            "sparknet_hierarchy_rounds_total",
+            "averaging rounds by tier: intra = within-slice (ICI) "
+            "average only, cross = the every-K-rounds global (DCN) "
+            "average",
+            labels=("tier",),
+        )
+        self.hierarchy_bytes = registry.counter(
+            "sparknet_hierarchy_bytes_total",
+            "modeled collective payload bytes by tier (ring factor x "
+            "payload; the cross series is what the two-tier schedule "
+            "divides by K vs an every-round flat run)",
+            labels=("tier",),
+        )
         # fleet-shipper series (obs/ship.py, --ship_to) — zero until a
         # run ships to a fleet collector
         self.ship_events = registry.counter(
@@ -327,6 +363,10 @@ _unhealthy_reason: Optional[str] = None
 # the active divergence sentry (obs/health.py) — /healthz exports its
 # state so an orchestrator can tell "stalled" from "diverged"
 _sentry = None
+# the active elastic membership controller (runtime/membership.py) —
+# /healthz exports its view so an orchestrator can tell "slice 1 is
+# leaving" from "the job is wedged"
+_membership = None
 
 
 def enable_training_metrics() -> TrainingMetrics:
@@ -353,11 +393,12 @@ def _reset_training_metrics_for_tests() -> None:
     """Drop the process singleton so a test gets fresh counters; NOT
     for production code (instrumented sites cache nothing, so the swap
     is safe mid-process)."""
-    global _training, _unhealthy_reason, _sentry
+    global _training, _unhealthy_reason, _sentry, _membership
     with _lock:
         _training = None
         _unhealthy_reason = None
         _sentry = None
+        _membership = None
         set_phase_observer(None)
         set_ship(None)
     flight.uninstall()
@@ -369,6 +410,21 @@ def set_sentry(sentry) -> None:
     flight bundles read its ``state_dict()``."""
     global _sentry
     _sentry = sentry
+
+
+def set_membership(controller) -> None:
+    """Register the run's MembershipController (None clears) —
+    /healthz gains a ``membership`` block with the current view."""
+    global _membership
+    _membership = controller
+
+
+def membership_state() -> Optional[dict]:
+    """The active membership controller's exported view, or None."""
+    m = _membership
+    if m is None:
+        return None
+    return m.state_dict()
 
 
 def sentry_state() -> Optional[dict]:
@@ -581,6 +637,8 @@ class ObsRun:
         # later run in this process must not inherit a halted /healthz
         # or embed this run's verdicts in its flight bundles
         set_sentry(None)
+        # ... and so is its membership controller (same scoping rule)
+        set_membership(None)
 
 
 def profile_summary_text(profiler) -> str:

@@ -87,6 +87,13 @@ class _ObsHandler(JsonHTTPHandler):
             prof = _obs.profile_state()
             if prof is not None:
                 payload["profile"] = prof
+            # elastic-membership block (--elastic): the current view
+            # epoch + per-worker states, so an orchestrator can tell
+            # "slice 1 left and is rejoining" from "wedged" — a
+            # degraded-but-training fleet stays 200
+            member = _obs.membership_state()
+            if member is not None:
+                payload["membership"] = member
             if reason:
                 payload.update({"status": "unhealthy", "reason": reason})
                 self._send_json(503, payload)

@@ -498,7 +498,8 @@ class HealthSentry:
         """One ``ParameterAveragingTrainer.round`` under the sentry."""
         r = self.rounds_observed if round_index is None else round_index
         state, losses, stats = trainer.round(
-            state, batches, rng=rng, live_mask=live_mask
+            state, batches, rng=rng, live_mask=live_mask,
+            round_index=round_index,
         )
         v = self.observe(r, losses, stats)
         if not v.ok:

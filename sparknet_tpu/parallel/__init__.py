@@ -18,6 +18,8 @@ and DCN across slices.
 """
 
 from sparknet_tpu.parallel import comm  # noqa: F401
+from sparknet_tpu.parallel import hierarchy  # noqa: F401
+from sparknet_tpu.parallel.hierarchy import HierarchySpec  # noqa: F401
 from sparknet_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
     local_device_count,

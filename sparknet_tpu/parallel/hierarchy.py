@@ -1,0 +1,183 @@
+"""Two-tier hierarchical averaging topology: the ``HierarchySpec``.
+
+The reference paper's own tau-vs-workers tradeoff (SparkNet §4) applied
+across the slice boundary: communication inside a TPU slice rides the
+ICI fabric (cheap, every round), communication *between* slices rides
+the DCN (expensive, amortized).  One declarative spec carries both
+decisions:
+
+- the **slice grouping** — which dp workers share a slice (on a real
+  pod: which workers share an ICI domain; on the virtual CPU mesh: a
+  declared partition of the dp axis), and
+- **K = cross_slice_every** — intra-slice parameter averaging runs
+  every round, the cross-slice (DCN) average every K-th round.
+
+``ParameterAveragingTrainer(hierarchy=spec)`` consumes the spec: rounds
+where ``(r + 1) % K != 0`` average within each slice only (a per-slice
+masked weighted mean, same survivor/sentry semantics as the global
+round), every K-th round runs the ordinary GLOBAL round — which is
+exactly today's single-tier program, so compression and overlap
+(``parallel/comm.py``) compose unchanged on the cross-slice tier.
+
+**Flat specs are bit-identical to today's round by construction**: a
+single-slice grouping or ``K == 1`` produces the single-tier schedule
+(every round global), and global rounds run the SAME jitted program as
+a hierarchy-less trainer — pinned like the PR-3/PR-5 identity tests.
+
+Virtual-mesh honesty (the PERF.md modeled-bytes convention): this jax
+build's shard_map does not lower ``psum(axis_index_groups=...)``, so
+the intra-slice tier is expressed as a stacked per-slice psum (each
+worker selects its own slice's row) — on the CPU simulation collectives
+are shared-memory copies either way, and the tier-split byte accounting
+(``sparknet_hierarchy_bytes_total{tier}``) models what the ICI vs DCN
+fabrics would actually carry.  On a real pod the same spec maps to a
+``(slice, worker)`` mesh factorization.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class HierarchySpec:
+    """A partition of the dp workers into slices plus the cross-slice
+    averaging cadence K.  Immutable and validated at construction."""
+
+    num_workers: int
+    slices: Tuple[Tuple[int, ...], ...]
+    cross_slice_every: int = 1
+
+    def __post_init__(self):
+        if self.num_workers < 1:
+            raise ValueError(f"num_workers={self.num_workers} < 1")
+        if self.cross_slice_every < 1:
+            raise ValueError(
+                f"cross_slice_every={self.cross_slice_every} < 1"
+            )
+        seen = [w for s in self.slices for w in s]
+        if sorted(seen) != list(range(self.num_workers)):
+            raise ValueError(
+                "slices must partition workers 0..%d exactly (got %r)"
+                % (self.num_workers - 1, self.slices)
+            )
+        if any(len(s) == 0 for s in self.slices):
+            raise ValueError("empty slice in %r" % (self.slices,))
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def flat(cls, num_workers: int) -> "HierarchySpec":
+        """The single-tier topology: one slice holding every worker.
+        A trainer given this spec is bit-identical to one given none."""
+        return cls(num_workers, (tuple(range(num_workers)),), 1)
+
+    @classmethod
+    def grouped(
+        cls, num_workers: int, num_slices: int, cross_slice_every: int = 1
+    ) -> "HierarchySpec":
+        """Contiguous near-equal grouping (the launcher's process->slice
+        rule): workers [0..n) split into ``num_slices`` blocks."""
+        num_slices = max(1, min(int(num_slices), num_workers))
+        bounds = [
+            round(i * num_workers / num_slices)
+            for i in range(num_slices + 1)
+        ]
+        slices = tuple(
+            tuple(range(bounds[i], bounds[i + 1]))
+            for i in range(num_slices)
+        )
+        return cls(num_workers, slices, cross_slice_every)
+
+    # ------------------------------------------------------------------
+    @property
+    def num_slices(self) -> int:
+        return len(self.slices)
+
+    def is_flat(self) -> bool:
+        """True when the schedule degenerates to single-tier: one slice,
+        or a cross-slice average every round.  The trainer then runs
+        the ordinary global program every round (bit-identity)."""
+        return self.num_slices <= 1 or self.cross_slice_every <= 1
+
+    def is_cross_round(self, r: int) -> bool:
+        """Whether absolute round ``r`` runs the cross-slice (global)
+        average.  Flat specs are always cross (= today's round)."""
+        return self.is_flat() or ((r + 1) % self.cross_slice_every) == 0
+
+    def slice_of(self, worker: int) -> int:
+        for i, s in enumerate(self.slices):
+            if worker in s:
+                return i
+        raise ValueError(f"worker {worker} not in any slice")
+
+    def slice_ids(self) -> Tuple[int, ...]:
+        """Per-worker slice index, worker-ordered — the static array the
+        trainer's intra-slice program closes over."""
+        out = [0] * self.num_workers
+        for i, s in enumerate(self.slices):
+            for w in s:
+                out[w] = i
+        return tuple(out)
+
+
+# ----------------------------------------------------------------------
+# CLI surface (the averaging apps share it, like parallel/comm.py's)
+
+
+def add_cli_args(parser) -> None:
+    """``--slices`` / ``--cross_slice_every`` / ``--elastic`` — the
+    two-tier topology + elastic-membership surface of the parameter-
+    averaging apps."""
+    parser.add_argument(
+        "--slices", type=int, default=1,
+        help="group the dp workers into N contiguous slices for two-"
+        "tier averaging: every-round psum inside a slice, cross-slice "
+        "(DCN) averaging every --cross_slice_every rounds.  1 = flat "
+        "(today's single-tier round, bit-identical)",
+    )
+    parser.add_argument(
+        "--cross_slice_every", type=int, default=1,
+        help="K: run the cross-slice (global) average every K-th round; "
+        "intra-slice rounds in between.  1 = every round global "
+        "(bit-identical to the flat schedule)",
+    )
+    parser.add_argument(
+        "--rejoin_after", type=int, default=2,
+        help="--elastic: request a departed slice's rejoin N round "
+        "boundaries after its leave completes (the single-process "
+        "stand-in for the orchestrator's relaunch notice; 0 = rejoin "
+        "only on external events — fleet views / note_join)",
+    )
+    parser.add_argument(
+        "--elastic", action="store_true",
+        help="arm the elastic membership controller "
+        "(runtime/membership.py): epoch-numbered views of the worker "
+        "roster drive the round's live_mask, a SIGTERM preemption "
+        "notice marks its slice leaving at the next round boundary, "
+        "and a departed slice rejoins at a later view epoch via "
+        "broadcast_state (membership metrics + /healthz block ride "
+        "--obs)",
+    )
+
+
+def spec_from_args(args, num_workers: int) -> Optional["HierarchySpec"]:
+    """Build the spec the CLI flags describe, or None for the flat
+    default (no spec at all — the trainer keeps its classic path)."""
+    slices = int(getattr(args, "slices", 1) or 1)
+    every = int(getattr(args, "cross_slice_every", 1) or 1)
+    if slices <= 1 and every <= 1 and not getattr(args, "elastic", False):
+        return None
+    return HierarchySpec.grouped(num_workers, max(1, slices), max(1, every))
+
+
+def trainer_kwargs_from_args(args, num_workers: int) -> dict:
+    """Trainer kwargs for the hierarchy from parsed CLI args (the
+    ``comm.comm_kwargs_from_args`` pattern)."""
+    return {"hierarchy": spec_from_args(args, num_workers)}
+
+
+def slice_members(nprocs: int, num_slices: int) -> Tuple[Tuple[int, ...], ...]:
+    """Contiguous process->slice grouping for the launcher's simulated
+    slice lifecycle (process indices, not worker indices)."""
+    return HierarchySpec.grouped(nprocs, num_slices).slices

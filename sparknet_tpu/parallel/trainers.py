@@ -23,6 +23,7 @@ multi-host pod (see ``mesh.initialize_distributed``).
 from __future__ import annotations
 
 import weakref
+from collections import OrderedDict
 from typing import Dict, Optional
 
 import jax
@@ -37,6 +38,7 @@ except AttributeError:  # pragma: no cover
 
 from sparknet_tpu import obs
 from sparknet_tpu.obs import profile as obs_profile
+from sparknet_tpu.parallel.hierarchy import HierarchySpec
 from sparknet_tpu.solver import Solver, TrainState
 from sparknet_tpu.utils.rngs import default_train_key
 
@@ -172,6 +174,10 @@ def replicate_global(tree, mesh: Mesh):
 class ParameterAveragingTrainer:
     """tau-step local SGD + parameter averaging over the ``dp`` axis."""
 
+    # placed-live-mask LRU bound (masks are small; the bound exists so
+    # churning membership views can never grow the cache monotonically)
+    _LIVE_CACHE_MAX = 64
+
     def __init__(
         self,
         solver: Solver,
@@ -185,6 +191,7 @@ class ParameterAveragingTrainer:
         comm_chunks: Optional[int] = None,
         overlap_steps: Optional[int] = None,
         comm_cost_ms_per_mb: Optional[float] = None,
+        hierarchy: Optional[HierarchySpec] = None,
     ):
         """``average_params=False`` skips the cross-worker pmean — a
         DIAGNOSTIC mode (workers then train fully independently): the
@@ -209,7 +216,17 @@ class ParameterAveragingTrainer:
         survivor mean (it rejoins healthy next round).  If NO worker is
         finite the round keeps each worker's own (poisoned) params so
         the host sentry sees the damage and escalates, instead of a
-        silent all-zero average."""
+        silent all-zero average.
+
+        ``hierarchy`` (``parallel/hierarchy.py``) declares the two-tier
+        averaging schedule: rounds where ``(r + 1) %
+        cross_slice_every != 0`` average WITHIN each slice only (pass
+        ``round_index`` to ``round()`` so resumed runs keep the
+        absolute schedule); every K-th round runs the ordinary GLOBAL
+        round — the same jitted program as today, so compression and
+        overlap compose unchanged on the cross-slice tier.  A flat
+        spec (one slice, or K == 1) yields the single-tier schedule
+        and is bit-identical to ``hierarchy=None`` by construction."""
         self.solver = solver
         self.mesh = mesh
         self.axis = axis
@@ -250,6 +267,20 @@ class ParameterAveragingTrainer:
                 mask_nonfinite=mask_nonfinite,
             )
         self._fused_payload_bytes: Optional[int] = None
+
+        # two-tier hierarchical averaging (parallel/hierarchy.py): the
+        # spec's slice grouping + K.  Flat specs never build the slice
+        # program — every round is the global round (bit-identity).
+        if hierarchy is not None and hierarchy.num_workers != self.num_workers:
+            raise ValueError(
+                f"hierarchy spec covers {hierarchy.num_workers} workers, "
+                f"mesh has {self.num_workers}"
+            )
+        self.hierarchy = hierarchy
+        self._two_tier = hierarchy is not None and not hierarchy.is_flat()
+        # schedule fallback when round() isn't handed an absolute
+        # round_index: counts this trainer's own round() calls
+        self._auto_round = 0
 
         audit = self.audit
         mask_nf = self.mask_nonfinite
@@ -354,8 +385,110 @@ class ParameterAveragingTrainer:
         obs.track_jit(self._round)  # feeds the jit-cache gauge
         # per-mask placed live masks, cached: the chaos/degraded loops
         # pass the SAME mask for many consecutive rounds, and the
-        # all-alive default mask is placed exactly once
-        self._live_cache: Dict[bytes, jax.Array] = {}
+        # all-alive default mask is placed exactly once.  A true LRU
+        # (move-to-front on hit, evict-oldest at the bound): elastic
+        # membership churns a fresh mask per view epoch, and the old
+        # clear-the-world overflow dropped the hot all-alive entry
+        # along with the churn.
+        self._live_cache: "OrderedDict[bytes, jax.Array]" = OrderedDict()
+
+        # intra-slice averaging program (two-tier schedule only): the
+        # same local window, but the averaging epilogue is a PER-SLICE
+        # masked weighted mean.  Expressed as a stacked per-slice psum
+        # (each worker selects its own slice's row) because this jax
+        # build's shard_map doesn't lower psum(axis_index_groups=...);
+        # on the virtual mesh collectives are shared-memory copies
+        # either way, and the tier byte accounting below models the
+        # ICI-vs-DCN split (hierarchy.py module docstring).
+        self._slice_round = None
+        if self._two_tier:
+            slice_ids = jnp.asarray(hierarchy.slice_ids(), jnp.int32)
+            num_slices = hierarchy.num_slices
+
+            def slice_body(state, batches, rng, live):
+                st = tree_map(lambda x: x[0], state)
+                bt = tree_map(lambda x: x[0], batches)
+                widx = jax.lax.axis_index(axis)
+                lrng = jax.random.fold_in(rng, widx)
+                st, out = solver._step_tau(st, bt, lrng)
+                if audit:
+                    losses, astats = out
+                else:
+                    losses = out
+                alive = live[0]
+                if mask_nf:
+                    bad = (
+                        jnp.sum(astats["nonfinite_grads"])
+                        + jnp.sum(astats["nonfinite_params"])
+                    ) > 0
+                    ok = jnp.where(bad, 0.0, 1.0)
+                    alive = alive * ok
+                    astats = dict(astats, masked=1.0 - ok)
+                sid = slice_ids[widx]
+                onehot = (
+                    jnp.arange(num_slices, dtype=jnp.int32) == sid
+                ).astype(jnp.float32)
+                # per-slice live counts, visible to every worker; each
+                # worker reads its OWN slice's count
+                denom0_all = jax.lax.psum(onehot * alive, axis)
+                denom0 = jnp.take(denom0_all, sid)
+                denom = jnp.maximum(denom0, 1.0)
+
+                def smean(w):
+                    contrib = jnp.where(alive > 0, w, jnp.zeros_like(w))
+                    stacked = (
+                        onehot.reshape((num_slices,) + (1,) * w.ndim)
+                        * contrib[None]
+                    )
+                    sums = jax.lax.psum(stacked, axis)
+                    m = jnp.take(
+                        sums, sid, axis=0
+                    ) / denom.astype(w.dtype)
+                    # a fully-departed slice keeps its own params (its
+                    # slots are stale until readmission broadcasts) —
+                    # unlike the global round there may be NO live
+                    # worker in this group even on a healthy fleet
+                    return jnp.where(denom0 > 0, m, w)
+
+                avg_params = (
+                    tree_map(smean, st.params)
+                    if average_params else st.params
+                )
+                avg_stats = (
+                    tree_map(smean, st.stats)
+                    if average_stats and average_params
+                    else st.stats
+                )
+                history = st.history
+                if mask_nf and average_params:
+                    # audit-masked worker rejoining its slice mean:
+                    # zero its momentum (the fused round's contract)
+                    rejoined = jnp.logical_and(bad, denom0 > 0)
+                    history = tree_map(
+                        lambda h: jnp.where(
+                            rejoined, jnp.zeros_like(h), h
+                        ),
+                        history,
+                    )
+                st = TrainState(avg_params, avg_stats, history, st.iter)
+                if audit:
+                    return (
+                        tree_map(lambda x: x[None], st),
+                        losses[None],
+                        tree_map(lambda x: x[None], astats),
+                    )
+                return tree_map(lambda x: x[None], st), losses[None]
+
+            self._slice_round = jax.jit(
+                shard_map(
+                    slice_body,
+                    mesh=mesh,
+                    in_specs=(P(axis), P(axis), P(), P(axis)),
+                    out_specs=out_specs,
+                ),
+                donate_argnums=(0, 1),
+            )
+            obs.track_jit(self._slice_round)
 
         def eval_body(state, batches, counts):
             # heterogeneous partitions: every worker's batches are padded
@@ -450,6 +583,9 @@ class ParameterAveragingTrainer:
         key = live.tobytes()
         cached = self._live_cache.get(key)
         if cached is not None:
+            # LRU hit: keep hot masks (the all-alive default, a standing
+            # fault pattern) resident while membership churn turns over
+            self._live_cache.move_to_end(key)
             return cached
         sharding = leading_sharding(self.mesh, self.axis)
         if jax.process_count() > 1:
@@ -458,8 +594,11 @@ class ParameterAveragingTrainer:
             )
         else:
             placed = jax.device_put(live, sharding)
-        if len(self._live_cache) >= 64:  # masks are few; never unbounded
-            self._live_cache.clear()
+        while len(self._live_cache) >= self._LIVE_CACHE_MAX:
+            # evict the coldest entry only: a churning mask stream
+            # (every membership view epoch is a new mask value) stays
+            # bounded WITHOUT dropping the hot entries alongside it
+            self._live_cache.popitem(last=False)
         self._live_cache[key] = placed
         return placed
 
@@ -469,6 +608,7 @@ class ParameterAveragingTrainer:
         batches: Dict[str, jax.Array],
         rng=None,
         live_mask=None,
+        round_index: Optional[int] = None,
     ):
         """One averaging round: ``batches[blob]`` is (num_workers, tau, ...)
         — worker-major, tau-deep.  Returns (state, losses (workers, tau)).
@@ -479,11 +619,22 @@ class ParameterAveragingTrainer:
         partition degrades throughput, never the weights.  ``None``
         means all alive (identical numerics to the unmasked round).
 
+        ``round_index`` is the ABSOLUTE round — only the two-tier
+        hierarchy schedule consumes it (which rounds cross slices);
+        omitted, the trainer counts its own calls, which is correct
+        for fresh runs but loses the absolute schedule across resumes.
+
         With the solver's numerics audit on, returns ``(state, losses,
         stats)`` where ``stats`` is the per-worker audit tree (leaves
         (num_workers, tau); plus ``masked`` (num_workers,) when the
         in-graph non-finite mask is armed)."""
         rng = rng if rng is not None else default_train_key(0)
+        # sparknet: sync-ok(round_index is a host int from the driver loop, never a device value)
+        r = self._auto_round if round_index is None else int(round_index)
+        self._auto_round = r + 1
+        # two-tier schedule: intra-slice rounds between cross-slice
+        # (global) ones; flat specs and hierarchy=None are always cross
+        intra = self._two_tier and not self.hierarchy.is_cross_round(r)
         # "average" is the whole averaging round (this method IS one
         # round of the SparkNet algorithm); "execute" nests inside it as
         # the fused XLA program's dispatch/execution.  Span timing stays
@@ -493,7 +644,29 @@ class ParameterAveragingTrainer:
             if live_mask is None:
                 live_mask = np.ones((self.num_workers,), np.float32)
             live = self._place_live(live_mask)  # cached per mask value
-            if self._comm is not None:
+            if intra:
+                # a pending overlapped CROSS-slice collective lands at
+                # this round boundary (its correction is global
+                # consensus — applying it after a slice-local average
+                # would de-synchronize slices); with K > 1 the overlap
+                # window is the boundary gap, disclosed in PERF.md
+                if self._comm is not None:
+                    state = self._comm.finalize(state)
+                with obs.span("execute"):
+                    if self.audit:
+                        state, losses, astats = self._slice_round(
+                            state, batches, rng, live
+                        )
+                    else:
+                        state, losses = self._slice_round(
+                            state, batches, rng, live
+                        )
+                tm = obs.training_metrics()
+                if tm is not None and self.average_params:
+                    tm.collective_bytes.labels("none").inc(
+                        self._payload_bytes(state)
+                    )
+            elif self._comm is not None:
                 # comm plane: delta-quantized chunked collectives,
                 # optionally overlapped with the next round's compute
                 out = self._comm.round(
@@ -518,17 +691,25 @@ class ParameterAveragingTrainer:
                     # the fused fp32 collective's modeled wire bytes
                     # (ring factor x params+stats payload) — computed
                     # once, charged per round
-                    if self._fused_payload_bytes is None:
-                        from sparknet_tpu.parallel import comm as _comm
-
-                        self._fused_payload_bytes = (
-                            _comm.fused_round_payload_bytes(
-                                state, self.average_stats
-                            )
-                        )
                     tm.collective_bytes.labels("none").inc(
-                        self._fused_payload_bytes
+                        self._payload_bytes(state)
                     )
+            # tier-split byte/round accounting for hierarchy runs: the
+            # intra series models the ICI (in-slice) fabric, the cross
+            # series the DCN — the quantity the two-tier schedule
+            # divides by K (bench.py --mode=elastic pins the ratio)
+            tm = obs.training_metrics()
+            if (
+                tm is not None
+                and self.hierarchy is not None
+                and self.average_params
+            ):
+                tier = "intra" if intra else "cross"
+                payload = self._payload_bytes(state)
+                if not intra and self._comm is not None:
+                    payload = self._comm.payload_bytes_per_round or payload
+                tm.hierarchy_rounds.labels(tier).inc()
+                tm.hierarchy_bytes.labels(tier).inc(payload)
             # recorded lazily: smoothed_loss pulls the worker-mean of the
             # addressable shards on read (Solver._drain_losses) — no
             # device->host sync in the round loop
@@ -548,6 +729,18 @@ class ParameterAveragingTrainer:
         if self.audit:
             return state, losses, astats
         return state, losses
+
+    def _payload_bytes(self, state) -> int:
+        """Modeled per-round fp32 collective payload bytes (ring factor
+        x params+stats), computed once per trainer from the state's
+        shapes."""
+        if self._fused_payload_bytes is None:
+            from sparknet_tpu.parallel import comm as _comm
+
+            self._fused_payload_bytes = _comm.fused_round_payload_bytes(
+                state, self.average_stats
+            )
+        return self._fused_payload_bytes
 
     def _note_profile_work(self, prof, tau: int, state) -> None:
         """Hand the profiler this trainer's modeled per-round work: MXU
@@ -572,12 +765,8 @@ class ParameterAveragingTrainer:
             payload = self._comm.payload_bytes_per_round or None
             compress = self._comm.compress
         else:
-            if self._fused_payload_bytes is None and self.average_params:
-                from sparknet_tpu.parallel import comm as _comm
-
-                self._fused_payload_bytes = _comm.fused_round_payload_bytes(
-                    state, self.average_stats
-                )
+            if self.average_params:
+                self._payload_bytes(state)
             payload = self._fused_payload_bytes
             compress = "none"
         prof.note_round_work(

@@ -162,6 +162,28 @@ class FaultPlan:
     # delivery watcher rejects it at verify (it never reaches a
     # canary) and quarantines the publish *.corrupt.
     publish_corrupt_round: Optional[int] = 5
+    # slice_preemption: a REAL SIGTERM at the END of this round is the
+    # orchestrator's preemption notice for a whole slice (the
+    # membership controller's SIGTERM hook marks slice
+    # slice_preempt_slice leaving; runtime/membership.py).  The
+    # departed workers leave the average at the next round boundary
+    # (view epoch), train masked while gone, and the relaunched slice
+    # requests a rejoin slice_relaunch_delta rounds after the notice —
+    # readmitted via a fresh consensus snapshot ->
+    # restore_newest_valid -> broadcast_state with momentum zeroed.
+    # Survived = views advanced leave -> dead -> rejoin with monotonic
+    # epochs, the leave detected at EXACTLY round R+1, the average
+    # renormalized over survivors every intervening round, and the
+    # final roster fully live.  Before the SIGHUP preemption's round so
+    # the leave lands pre-resume and the replay can't re-fire it; the
+    # run also arms a two-tier HierarchySpec (membership_slices x
+    # cross_slice_every), so the chaos proof covers the hierarchical
+    # schedule too.
+    slice_preempt_round: Optional[int] = 2
+    slice_preempt_slice: int = 0
+    slice_relaunch_delta: int = 1  # note_join at END of round R+delta
+    membership_slices: int = 2
+    cross_slice_every: int = 2
 
     @classmethod
     def default(cls) -> "FaultPlan":
@@ -183,6 +205,7 @@ class FaultPlan:
             collector_outage_round=None,
             replica_death_round=None,
             publish_corrupt_round=None,
+            slice_preempt_round=None,
         )
 
 
@@ -858,7 +881,24 @@ def run_chaos(
     mesh = make_mesh(
         {"dp": plan.workers}, devices=jax.devices()[: plan.workers]
     )
-    trainer = ParameterAveragingTrainer(solver, mesh)
+    # slice_preemption runs the whole scenario on the two-tier
+    # hierarchical schedule (parallel/hierarchy.py): every-round psum
+    # within a slice, cross-slice average every cross_slice_every
+    # rounds — both legs (baseline + faulted) use the same spec so the
+    # loss comparison stays like-for-like
+    from sparknet_tpu.parallel.hierarchy import HierarchySpec
+    from sparknet_tpu.runtime import membership as membership_mod
+
+    spec = None
+    membership_ctl = None
+    if plan.slice_preempt_round is not None:
+        spec = HierarchySpec.grouped(
+            plan.workers, plan.membership_slices, plan.cross_slice_every
+        )
+        membership_ctl = membership_mod.MembershipController(
+            spec, echo=note
+        )
+    trainer = ParameterAveragingTrainer(solver, mesh, hierarchy=spec)
     sentry = None
     if audit:
         from sparknet_tpu.obs.health import HealthSentry
@@ -884,7 +924,8 @@ def run_chaos(
     state = trainer.init_state(seed=plan.seed)
     losses = None
     for r in range(plan.rounds):
-        out = trainer.round(state, feed.next_round(r))
+        # round_index keeps the two-tier schedule absolute in BOTH legs
+        out = trainer.round(state, feed.next_round(r), round_index=r)
         state, losses = out[0], out[1]  # audit runs drop the stats here
     feed.close()
     baseline_loss = final_round_loss(losses)
@@ -911,7 +952,15 @@ def run_chaos(
 
     def take_snapshot(r: int) -> Tuple[str, str]:
         nonlocal snapshots
-        st = first_worker(jax.device_get(state))
+        if membership_ctl is not None:
+            # a departed slice's slots can hold stale params between
+            # cross rounds — snapshot the first LIVE worker's consensus
+            st = membership_mod.consensus_state(
+                state, last_mask["m"] if last_mask["m"] is not None
+                else np.ones((plan.workers,), np.float32)
+            )
+        else:
+            st = first_worker(jax.device_get(state))
         paths = checkpoint.snapshot(solver, st, prefix, fmt="BINARYPROTO")
         snapshots += 1
         note(f"round {r}: snapshot -> {os.path.basename(paths[1])}")
@@ -924,12 +973,13 @@ def run_chaos(
         mask[plan.dead_worker] = 0.0
         return mask
 
+    last_mask: Dict = {"m": None}  # the combined mask the round used
+
     def run_round(fd: _Feed, r: int) -> None:
         """One training round of the faulted run (shared by the
         pre-preemption loop and the post-resume replay — fault
         accounting must stay identical in both)."""
         nonlocal state, losses
-        batches = fd.next_round(r)  # placed by the pipelined feed
         mask = live_mask_for(r)
         if mask is not None and r == plan.dead_from_round:
             counters["dead_worker_injected"] = 1
@@ -938,7 +988,42 @@ def run_chaos(
                 f"round {r}: dp worker {plan.dead_worker} died; "
                 "averaging over survivors"
             )
-        out = trainer.round(state, batches, live_mask=mask)
+        if membership_ctl is not None:
+            # the membership view advances at the round BOUNDARY: the
+            # preempted slice departs here, not mid-round
+            mview = membership_ctl.advance(r)
+            if membership_ctl.pending_joiners():
+                joiners = membership_ctl.pending_joiners()
+                combined = mview.live_mask()
+                if mask is not None:
+                    combined = combined * mask
+                state, _ = membership_mod.readmit(
+                    trainer, solver, state, prefix, membership_ctl,
+                    r, live_mask=combined, snapshot_fmt="BINARYPROTO",
+                    echo=note,
+                )
+                counters.setdefault("slice_rejoin_round", r)
+                _obs.instant(
+                    "recovered", kind="slice_preemption", round=r,
+                    workers=list(joiners),
+                )
+                mview = membership_ctl.view
+            mmask = membership_ctl.live_mask()
+            mask = mmask if mask is None else mmask * mask
+            if (
+                counters.get("slice_preempt_injected")
+                and "slice_leave_round" not in counters
+                and any(s != membership_mod.LIVE for s in mview.states)
+            ):
+                counters["slice_leave_round"] = r
+            sw = spec.slices[plan.slice_preempt_slice]
+            if all(mask[w] == 0.0 for w in sw):
+                # a set: post-resume replays revisit rounds by absolute
+                # index and must not double-count them
+                counters.setdefault("slice_masked_rounds", set()).add(r)
+        last_mask["m"] = mask
+        batches = fd.next_round(r)  # placed by the pipelined feed
+        out = trainer.round(state, batches, live_mask=mask, round_index=r)
         state, losses = out[0], out[1]
         if sentry is not None:
             verdict = sentry.observe(r, losses, out[2])
@@ -997,6 +1082,39 @@ def run_chaos(
                 r, solver,
                 lambda: first_worker(jax.device_get(state)),
             )
+        if membership_ctl is not None:
+            if (
+                r == plan.slice_preempt_round
+                and not counters.get("slice_preempt_injected")
+            ):
+                # a REAL SIGTERM: the orchestrator's preemption notice
+                # for slice slice_preempt_slice — the membership
+                # controller's hook marks it leaving; the process (and
+                # the job) keeps running
+                counters["slice_preempt_injected"] = 1
+                sw = list(spec.slices[plan.slice_preempt_slice])
+                _obs.fault(
+                    "slice_preemption", round=r,
+                    slice=plan.slice_preempt_slice, workers=sw,
+                )
+                note(
+                    f"round {r}: SIGTERM preemption notice for slice "
+                    f"{plan.slice_preempt_slice} (workers {sw})"
+                )
+                os.kill(os.getpid(), _signal.SIGTERM)
+            if (
+                counters.get("slice_preempt_injected")
+                and r == plan.slice_preempt_round
+                + plan.slice_relaunch_delta
+                and not counters.get("slice_relaunched")
+            ):
+                counters["slice_relaunched"] = 1
+                sw = spec.slices[plan.slice_preempt_slice]
+                membership_ctl.note_join(sw)
+                note(
+                    f"round {r}: slice {plan.slice_preempt_slice} "
+                    "relaunched — rejoin requested"
+                )
 
     # the round profiler attributes the seeded straggler (installed for
     # the faulted run only; the baseline above ran unprofiled)
@@ -1016,10 +1134,16 @@ def run_chaos(
     ):
         serve_faults = _ServeFaults(plan, counters, note, workdir)
     t_preempt = None
+    if membership_ctl is not None:
+        # SIGTERM -> "slice slice_preempt_slice is being preempted"
+        # (utils/signals.py hook; the handler itself is installed by
+        # the SignalHandler below via sigterm_hooks=True)
+        membership_ctl.sigterm_marks(plan.slice_preempt_slice)
     try:
         with SignalHandler(
             sigint_effect=SolverAction.NONE,
             sighup_effect=SolverAction.SNAPSHOT,
+            sigterm_hooks=membership_ctl is not None,
         ) as handler:
             for r in range(plan.rounds):
                 run_round(feed, r)
@@ -1104,6 +1228,8 @@ def run_chaos(
                 run_round(feed, r)
             feed.close()
     finally:
+        if membership_ctl is not None:
+            membership_ctl.detach()
         if profiler is not None:
             _profile.uninstall(profiler)
         if serve_faults is not None:
@@ -1117,6 +1243,38 @@ def run_chaos(
     final_loss = final_round_loss(losses)
     if counters.get("dead_worker_injected") and np.isfinite(final_loss):
         counters["dead_worker_survived"] = 1
+    if counters.get("slice_preempt_injected") and membership_ctl is not None:
+        # survived = the departure took effect at EXACTLY the round
+        # boundary after the notice, every intervening round's average
+        # excluded the departed slice (renormalized over survivors),
+        # the views advanced with monotonic epochs, and the rejoin
+        # completed (whole roster live again)
+        leave_r = counters.get("slice_leave_round")
+        rejoin_r = counters.get("slice_rejoin_round")
+        masked = set(counters.get("slice_masked_rounds", []))
+        gone = (
+            set(range(leave_r, rejoin_r))
+            if leave_r is not None and rejoin_r is not None
+            else None
+        )
+        if (
+            leave_r == plan.slice_preempt_round + 1
+            and gone is not None
+            and gone <= masked
+            and membership_ctl.epochs_monotonic()
+            and all(
+                s == membership_mod.LIVE
+                for s in membership_ctl.view.states
+            )
+            and np.isfinite(final_loss)
+        ):
+            counters["slice_preempt_survived"] = 1
+            note(
+                "slice preemption survived: left at round %d, masked "
+                "rounds %s, rejoined at round %d, final epoch %d"
+                % (leave_r, sorted(masked), rejoin_r,
+                   membership_ctl.epoch)
+            )
 
     loss_band = max(0.25, 0.25 * abs(baseline_loss))
     loss_band_ok = bool(abs(final_loss - baseline_loss) <= loss_band)
@@ -1151,6 +1309,9 @@ def run_chaos(
         "published_snapshot_corrupt": (
             "publish_corrupt_injected", "publish_corrupt_survived",
         ),
+        "slice_preemption": (
+            "slice_preempt_injected", "slice_preempt_survived",
+        ),
     }
     faults = {
         kind: {
@@ -1184,6 +1345,17 @@ def run_chaos(
         "collector_outage": outage.summary if outage is not None else None,
         "replica_death_round": plan.replica_death_round,
         "publish_corrupt_round": plan.publish_corrupt_round,
+        "slice_preempt_round": plan.slice_preempt_round,
+        "slice_preempt_slice": plan.slice_preempt_slice,
+        "slice_leave_round": counters.get("slice_leave_round"),
+        "slice_rejoin_round": counters.get("slice_rejoin_round"),
+        "slice_masked_rounds": sorted(
+            counters.get("slice_masked_rounds", [])
+        ),
+        "membership": (
+            membership_ctl.state_dict()
+            if membership_ctl is not None else None
+        ),
         # the faulted run's own cache traffic (baseline-leg reads on the
         # shared cache subtracted out)
         "cache_stats": {

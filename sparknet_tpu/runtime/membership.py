@@ -1,0 +1,606 @@
+"""Elastic worker membership: train through a preempted slice.
+
+The dp mesh is fixed at launch, but the WORKERS behind it are not: a
+preemptible TPU slice can be taken away mid-run and handed back minutes
+later.  ``live_mask`` (PR 2) and the in-graph sentry mask (PR 5)
+already renormalize the average over survivors; this module adds the
+missing control plane — an epoch-numbered **membership view** of the
+worker roster that decides WHAT the mask is each round, and a
+readmission path that brings a departed slice back without stopping
+the job.
+
+One ``MembershipController`` per driver process:
+
+- **views are epoch-numbered and advance only at round boundaries.**
+  Signals (a SIGTERM preemption notice, a fleet-collector liveness
+  verdict, a chaos fault, an explicit join request) enqueue *events*;
+  ``advance(round)`` applies them all at once, bumps the epoch exactly
+  once per changed view, and returns the new ``MembershipView``.  The
+  trainer never sees a mid-round roster change — departures take
+  effect at the next boundary with no collective hang (the mesh shape
+  never changes; only the mask does).
+- **worker states**: ``live -> leaving -> dead -> joining -> live``.
+  A preemption notice or a LATE heartbeat demotes to ``leaving`` (the
+  worker may still come back — late is not dead); a missed deadline or
+  an explicit death, or ``leave_grace_rounds`` boundaries spent
+  leaving, completes the departure to ``dead``.  A join request on a
+  ``dead`` worker makes it ``joining``; a join requested while the
+  worker is still ``leaving`` is DEFERRED until the leave completes
+  (the rejoin-before-leave-completes ordering).  Only ``live`` workers
+  carry mask weight.
+- **readmission**: a ``joining`` worker is admitted at a view epoch by
+  the driver — catch up through ``io/checkpoint.restore_newest_valid``
+  (the snapshot is how weights travel to a relaunched process), place
+  via ``ParameterAveragingTrainer.broadcast_state``, merge ONLY the
+  rejoining rows into the live stacked state, and zero the rejoiners'
+  momentum history (the PR-5 rejoin contract).  ``readmit`` below is
+  that whole dance; ``admit()`` then flips joining -> live at the next
+  epoch.
+- **fleet feed** (PR 10): ``ingest_fleet_view`` translates the
+  collector's per-host ``live|late|dead`` verdicts + ``boot_id``
+  restart detection into membership events, given a host -> workers
+  mapping — the 2-process e2e proof kills and relaunches a real
+  shipper process and watches the views walk leave -> rejoin.
+
+Telemetry: every epoch bump sets ``sparknet_membership_epoch`` and the
+per-state ``sparknet_membership_workers`` gauges, counts
+``sparknet_membership_transitions_total{kind}`` and emits a
+``membership_view`` instant on the run log; ``obs.set_membership``
+exports the controller's ``state_dict()`` on ``/healthz``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from sparknet_tpu import obs as _obs
+from sparknet_tpu.parallel.hierarchy import HierarchySpec
+
+LIVE = "live"
+LEAVING = "leaving"
+DEAD = "dead"
+JOINING = "joining"
+STATES = (LIVE, LEAVING, DEAD, JOINING)
+
+
+class MembershipView:
+    """One immutable epoch-numbered snapshot of the roster."""
+
+    __slots__ = ("epoch", "round", "states", "spec")
+
+    def __init__(
+        self,
+        epoch: int,
+        round: int,
+        states: Tuple[str, ...],
+        spec: HierarchySpec,
+    ):
+        self.epoch = epoch
+        self.round = round
+        self.states = states
+        self.spec = spec
+
+    def live_mask(self) -> np.ndarray:
+        """The (num_workers,) 0/1 mask the trainer consumes: only LIVE
+        workers carry weight — leaving/dead/joining are all excluded
+        from the average until (re)admitted."""
+        return np.asarray(
+            [1.0 if s == LIVE else 0.0 for s in self.states], np.float32
+        )
+
+    def workers_in(self, state: str) -> Tuple[int, ...]:
+        return tuple(
+            w for w, s in enumerate(self.states) if s == state
+        )
+
+    def counts(self) -> Dict[str, int]:
+        out = {s: 0 for s in STATES}
+        for s in self.states:
+            out[s] += 1
+        return out
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return (
+            f"MembershipView(epoch={self.epoch}, round={self.round}, "
+            f"states={self.states})"
+        )
+
+
+class MembershipController:
+    """Maintains the roster; thread-safe on the event side (signal
+    handlers, heartbeat threads), single-driver on ``advance``."""
+
+    def __init__(
+        self,
+        spec: HierarchySpec,
+        leave_grace_rounds: int = 1,
+        echo: Optional[Callable[[str], None]] = None,
+    ):
+        self.spec = spec
+        self.num_workers = spec.num_workers
+        self.leave_grace_rounds = max(0, int(leave_grace_rounds))
+        self._echo = echo
+        self._lock = threading.Lock()
+        self._states: List[str] = [LIVE] * self.num_workers
+        self._epoch = 0
+        self._round = -1
+        self._leaving_since: Dict[int, int] = {}
+        # events queued from any thread, applied at the next advance():
+        # (kind, workers) with kind in preempt|late|dead|join
+        self._events: List[Tuple[str, Tuple[int, ...]]] = []
+        # joins that arrived while the worker had not finished leaving
+        self._deferred_joins: set = set()
+        self._view = MembershipView(
+            0, -1, tuple(self._states), spec
+        )
+        # transition log: (epoch, round, kind, workers) — the proof the
+        # chaos/bench verdicts read ("views advanced leave -> rejoin")
+        self.transitions: List[Tuple[int, int, str, Tuple[int, ...]]] = []
+        self._sigterm_hook = None
+        self._host_boot_ids: Dict[str, Optional[str]] = {}
+        self._publish_metrics()
+
+    # ------------------------------------------------------------------
+    # event side — safe from signal handlers / heartbeat threads
+    def _queue(self, kind: str, workers: Iterable[int]) -> None:
+        ws = tuple(int(w) for w in workers)
+        if not ws:
+            return
+        # DELIBERATELY lock-free: the SIGTERM preemption hook runs in
+        # signal-handler context ON the driver thread — taking
+        # self._lock there deadlocks if the signal lands while
+        # advance()/admit() hold it (a non-reentrant Lock on the same
+        # thread).  A CPython list.append is atomic, and advance()'s
+        # swap-drain never loses a concurrent append, so the queue
+        # needs no lock (the signals.py hook contract: no locks).
+        self._events.append((kind, ws))
+
+    def note_preempt(
+        self,
+        workers: Optional[Sequence[int]] = None,
+        slice_index: Optional[int] = None,
+    ) -> None:
+        """A preemption notice (SIGTERM / chaos fault): the named
+        workers — or a whole slice — start LEAVING at the next round
+        boundary."""
+        if workers is None:
+            if slice_index is None:
+                raise ValueError("pass workers or slice_index")
+            workers = self.spec.slices[slice_index]
+        self._queue("preempt", workers)
+
+    def note_late(self, workers: Sequence[int]) -> None:
+        """A late heartbeat demotes to LEAVING, never straight to dead
+        — a slow host may catch up (the fleet plane's late-vs-dead
+        distinction, preserved here)."""
+        self._queue("late", workers)
+
+    def note_dead(self, workers: Sequence[int]) -> None:
+        """A hard death (missed push deadline, process gone)."""
+        self._queue("dead", workers)
+
+    def note_join(self, workers: Sequence[int]) -> None:
+        """A (re)join request — honored once the worker's leave has
+        completed (dead), at a later view epoch."""
+        self._queue("join", workers)
+
+    # --- SIGTERM preemption wiring (utils/signals.py hook) ---
+    def sigterm_marks(self, slice_index: int):
+        """Register a SIGTERM hook marking ``slice_index`` preempted
+        (the orchestrator's notice names this process's slice).  Use
+        with a ``SignalHandler(sigterm_hooks=True)`` scope; returns the
+        hook so callers can detach early."""
+        from sparknet_tpu.utils import signals as _signals
+
+        workers = self.spec.slices[slice_index]
+
+        def hook():
+            self.note_preempt(workers=workers)
+
+        self._sigterm_hook = _signals.add_sigterm_hook(hook)
+        return hook
+
+    def detach(self) -> None:
+        if self._sigterm_hook is not None:
+            from sparknet_tpu.utils import signals as _signals
+
+            _signals.remove_sigterm_hook(self._sigterm_hook)
+            self._sigterm_hook = None
+
+    # --- fleet-plane feed (obs/fleet.py views) ---
+    def ingest_fleet_view(
+        self, view: Dict, host_workers: Dict[str, Sequence[int]]
+    ) -> None:
+        """Translate a collector ``fleet_view()`` into membership
+        events: a ``late`` host's workers start leaving, a ``dead``
+        host's workers die, and a host seen LIVE again after its
+        workers departed — or whose ``boot_id`` changed (process
+        restart) — requests a rejoin for its workers."""
+        hosts = view.get("hosts", {})
+        for host, workers in host_workers.items():
+            st = hosts.get(host)
+            if st is None:
+                continue
+            hstate = st.get("state")
+            boot = st.get("boot_id")
+            prev_boot = self._host_boot_ids.get(host)
+            restarted = (
+                prev_boot is not None
+                and boot is not None
+                and boot != prev_boot
+            )
+            self._host_boot_ids[host] = boot
+            with self._lock:
+                cur = {self._states[w] for w in workers}
+            if hstate == "dead":
+                self.note_dead(workers)
+            elif hstate == "late":
+                if LIVE in cur:
+                    self.note_late(workers)
+            elif hstate == "live":
+                if restarted and LIVE in cur:
+                    # the host restarted BETWEEN polls (boot_id flipped
+                    # while its workers were still marked live): the
+                    # old incarnation's training state is GONE, so the
+                    # fresh process must walk the full leave -> rejoin
+                    # path — dead now, readmitted with catch-up weights
+                    # and zeroed momentum at a later epoch — never
+                    # averaged in raw under the stale live mask
+                    self.note_dead(workers)
+                    self.note_join(workers)
+                elif restarted or cur <= {DEAD, LEAVING, JOINING}:
+                    if cur != {JOINING} and cur != {LIVE}:
+                        self.note_join(workers)
+
+    # ------------------------------------------------------------------
+    # driver side — round boundaries
+    @property
+    def view(self) -> MembershipView:
+        return self._view
+
+    @property
+    def epoch(self) -> int:
+        return self._view.epoch
+
+    def live_mask(self) -> np.ndarray:
+        return self._view.live_mask()
+
+    def pending_joiners(self) -> Tuple[int, ...]:
+        return self._view.workers_in(JOINING)
+
+    def advance(self, round_index: int) -> MembershipView:
+        """Apply every queued event at this round boundary; bump the
+        epoch exactly once if anything changed.  Ordering within one
+        boundary: demotions (preempt/late), deaths, leave-completions
+        — and only THEN join requests, restricted to workers that were
+        already dead BEFORE this boundary (a join racing its own leave
+        waits for the next boundary: leave completes first)."""
+        with self._lock:
+            # swap-drain of the lock-free queue: an append racing the
+            # swap lands on one of the two lists and is processed this
+            # boundary or the next — never lost
+            events, self._events = self._events, []
+            dead_before = {
+                w for w, s in enumerate(self._states) if s == DEAD
+            }
+            changed: List[Tuple[str, Tuple[int, ...]]] = []
+
+            def move(w: int, to: str) -> bool:
+                if self._states[w] == to:
+                    return False
+                self._states[w] = to
+                return True
+
+            for kind, ws in events:
+                if kind in ("preempt", "late"):
+                    moved = tuple(
+                        w for w in ws
+                        if self._states[w] == LIVE and move(w, LEAVING)
+                    )
+                    for w in moved:
+                        self._leaving_since[w] = round_index
+                    if moved:
+                        changed.append(("leave" if kind == "preempt"
+                                        else "late", moved))
+                elif kind == "dead":
+                    moved = tuple(
+                        w for w in ws
+                        if self._states[w] in (LIVE, LEAVING)
+                        and move(w, DEAD)
+                    )
+                    for w in moved:
+                        self._leaving_since.pop(w, None)
+                    if moved:
+                        changed.append(("death", moved))
+                elif kind == "join":
+                    self._deferred_joins.update(int(w) for w in ws)
+
+            # leave-completion: a worker that has sat out
+            # leave_grace_rounds boundaries finishes departing
+            expired = tuple(
+                w for w, since in list(self._leaving_since.items())
+                if self._states[w] == LEAVING
+                and round_index - since >= self.leave_grace_rounds
+            )
+            for w in expired:
+                move(w, DEAD)
+                self._leaving_since.pop(w, None)
+            if expired:
+                changed.append(("death", expired))
+
+            # joins only for workers whose leave completed BEFORE this
+            # boundary — the rejoin-before-leave-completes ordering
+            ready = tuple(
+                w for w in sorted(self._deferred_joins)
+                if w in dead_before and self._states[w] == DEAD
+            )
+            for w in ready:
+                move(w, JOINING)
+                self._deferred_joins.discard(w)
+            if ready:
+                changed.append(("join_request", ready))
+            # drop deferred joins for workers that are live again
+            self._deferred_joins = {
+                w for w in self._deferred_joins
+                if self._states[w] in (LEAVING, DEAD)
+            }
+
+            self._round = int(round_index)
+            if changed:
+                self._epoch += 1
+                self._view = MembershipView(
+                    self._epoch, self._round, tuple(self._states),
+                    self.spec,
+                )
+                for kind, ws in changed:
+                    self.transitions.append(
+                        (self._epoch, self._round, kind, ws)
+                    )
+            else:
+                self._view = MembershipView(
+                    self._view.epoch, self._round, tuple(self._states),
+                    self.spec,
+                )
+        if changed:
+            self._note_changes(changed)
+        return self._view
+
+    def admit(
+        self, round_index: int, workers: Optional[Sequence[int]] = None
+    ) -> MembershipView:
+        """Flip ``joining`` workers to ``live`` (the driver just
+        readmitted their state): a new view epoch."""
+        with self._lock:
+            ws = tuple(
+                int(w) for w in (
+                    workers if workers is not None
+                    else [w for w, s in enumerate(self._states)
+                          if s == JOINING]
+                )
+                if self._states[int(w)] == JOINING
+            )
+            if not ws:
+                return self._view
+            for w in ws:
+                self._states[w] = LIVE
+            self._epoch += 1
+            self._round = int(round_index)
+            self._view = MembershipView(
+                self._epoch, self._round, tuple(self._states), self.spec
+            )
+            self.transitions.append(
+                (self._epoch, self._round, "rejoin", ws)
+            )
+        self._note_changes([("rejoin", ws)])
+        return self._view
+
+    # ------------------------------------------------------------------
+    def _note_changes(
+        self, changed: List[Tuple[str, Tuple[int, ...]]]
+    ) -> None:
+        view = self._view
+        if self._echo is not None:
+            for kind, ws in changed:
+                self._echo(
+                    "membership: epoch %d (round %d): %s %s -> %s"
+                    % (view.epoch, view.round, kind, list(ws),
+                       dict(view.counts()))
+                )
+        _obs.instant(
+            "membership_view", cat="membership",
+            epoch=view.epoch, round=view.round,
+            changes=[[k, list(ws)] for k, ws in changed],
+            counts=view.counts(),
+        )
+        tm = _obs.training_metrics()
+        if tm is not None:
+            for kind, ws in changed:
+                tm.membership_transitions.labels(kind).inc(len(ws))
+        self._publish_metrics()
+
+    def _publish_metrics(self) -> None:
+        tm = _obs.training_metrics()
+        if tm is None:
+            return
+        tm.membership_epoch.set(self._view.epoch)
+        for s, n in self._view.counts().items():
+            tm.membership_workers.labels(s).set(n)
+
+    def state_dict(self) -> Dict:
+        """The /healthz membership block (obs.set_membership)."""
+        view = self._view
+        return {
+            "epoch": view.epoch,
+            "round": view.round,
+            "workers": view.counts(),
+            "states": list(view.states),
+            "slices": [list(s) for s in self.spec.slices],
+            "cross_slice_every": self.spec.cross_slice_every,
+            "pending_joiners": list(view.workers_in(JOINING)),
+            "transitions": len(self.transitions),
+        }
+
+    def epochs_monotonic(self) -> bool:
+        """True iff the logged transition epochs strictly increase per
+        bump (the chaos/bench verdict helper)."""
+        es = [e for e, _, _, _ in self.transitions]
+        return all(b >= a for a, b in zip(es, es[1:]))
+
+
+class AutoRejoin:
+    """Driver-side rejoin policy for single-process runs: request a
+    departed worker's rejoin once its leave has COMPLETED (dead) and
+    ``after`` round boundaries have passed since it first left — the
+    stand-in for the orchestrator's relaunch notice (``cifar_app
+    --elastic --rejoin_after=N``).  Call ``on_round`` right after
+    ``advance``; ``after <= 0`` disables it (rejoins then come only
+    from external events: fleet views, chaos, note_join)."""
+
+    def __init__(self, controller: MembershipController, after: int):
+        self.controller = controller
+        self.after = int(after)
+        self._gone_since: Dict[int, int] = {}
+
+    def on_round(self, round_index: int) -> None:
+        if self.after <= 0:
+            return
+        view = self.controller.view
+        ready = []
+        for w, s in enumerate(view.states):
+            if s == LIVE:
+                self._gone_since.pop(w, None)
+                continue
+            self._gone_since.setdefault(w, round_index)
+            if (
+                s == DEAD
+                and round_index - self._gone_since[w] >= self.after
+            ):
+                ready.append(w)
+        if ready:
+            self.controller.note_join(ready)
+
+
+# ----------------------------------------------------------------------
+# readmission: catch up through a snapshot, broadcast, merge, zero
+# momentum — the rejoin contract
+
+
+def consensus_state(state, live_mask):
+    """A single-replica host TrainState read from the FIRST LIVE worker
+    slot of a stacked state (dead slots may hold stale params under the
+    intra-slice tier, so "worker 0" is not always safe)."""
+    import jax
+
+    mask = np.asarray(live_mask, np.float32).reshape(-1)
+    live = np.flatnonzero(mask > 0)
+    w = int(live[0]) if live.size else 0
+    host = jax.device_get(state)
+    import jax.tree_util as tu
+
+    return tu.tree_map(lambda x: x[w], host)
+
+
+def readmit_state(trainer, state, restored, workers):
+    """Merge a catch-up state into the stacked live state for the
+    ``workers`` being readmitted: their params/stats rows come from
+    ``restored`` (placed via ``trainer.broadcast_state`` — the
+    restore-on-every-executor semantics), their momentum HISTORY is
+    zeroed (the PR-5 rejoin contract: stale momentum must not replay),
+    and every OTHER row — the survivors — is untouched."""
+    import jax
+    import jax.numpy as jnp
+
+    tree_map = jax.tree_util.tree_map
+    full = trainer.broadcast_state(restored)
+    n = trainer.num_workers
+    row = np.zeros((n,), bool)
+    row[list(workers)] = True
+    rowj = jnp.asarray(row)
+
+    def pick(cur, new):
+        m = rowj.reshape((n,) + (1,) * (cur.ndim - 1))
+        return jnp.where(m, new, cur)
+
+    def zero_row(cur):
+        m = rowj.reshape((n,) + (1,) * (cur.ndim - 1))
+        return jnp.where(m, jnp.zeros_like(cur), cur)
+
+    return type(state)(
+        tree_map(pick, state.params, full.params),
+        tree_map(pick, state.stats, full.stats),
+        tree_map(zero_row, state.history),
+        state.iter,
+    )
+
+
+def readmit(
+    trainer,
+    solver,
+    state,
+    prefix: str,
+    controller: MembershipController,
+    round_index: int,
+    snapshot: bool = True,
+    live_mask=None,
+    snapshot_fmt: Optional[str] = None,
+    echo: Optional[Callable[[str], None]] = None,
+):
+    """The full readmission dance for every pending joiner: publish a
+    fresh consensus snapshot (so the catch-up source is current —
+    skipped when ``snapshot=False``), restore through
+    ``restore_newest_valid`` (quarantining corrupt snapshots exactly
+    like any other resume), merge the rejoiners in via
+    ``broadcast_state`` + ``readmit_state``, and ``admit()`` the new
+    epoch.  ``live_mask`` names which slots hold live consensus for the
+    snapshot (defaults to the controller's own view — pass the combined
+    mask when other fault channels also exclude workers).  Returns
+    ``(state, view_or_None)``."""
+    workers = controller.pending_joiners()
+    if not workers:
+        return state, None
+    from sparknet_tpu.io import checkpoint
+
+    if snapshot:
+        mask = (
+            controller.live_mask() if live_mask is None else live_mask
+        )
+        checkpoint.snapshot(
+            solver, consensus_state(state, mask), prefix,
+            fmt=snapshot_fmt,
+        )
+    restored, used = checkpoint.restore_newest_valid(solver, prefix)
+    state = readmit_state(trainer, state, restored, workers)
+    view = controller.admit(round_index)
+    if echo is not None:
+        import os
+
+        echo(
+            "membership: readmitted worker(s) %s from %s at epoch %d "
+            "(momentum zeroed)"
+            % (list(workers), os.path.basename(used), view.epoch)
+        )
+    return state, view
+
+
+def readmit_from_survivors(trainer, state, controller, round_index,
+                           echo=None):
+    """Snapshot-less readmission (drivers with no checkpoint
+    machinery): rejoiners take the live consensus state directly —
+    same merge + momentum-zeroing contract, the catch-up source is the
+    survivors' current weights instead of a restored snapshot."""
+    workers = controller.pending_joiners()
+    if not workers:
+        return state, None
+    restored = consensus_state(state, controller.live_mask())
+    state = readmit_state(trainer, state, restored, workers)
+    view = controller.admit(round_index)
+    if echo is not None:
+        echo(
+            "membership: readmitted worker(s) %s from the survivor "
+            "consensus at epoch %d (momentum zeroed)"
+            % (list(workers), view.epoch)
+        )
+    return state, view

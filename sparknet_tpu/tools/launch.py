@@ -100,7 +100,19 @@ def spawn_local(args, app_argv) -> int:
             collector.close()
 
 
+def proc_slice_members(nprocs: int, slices: int):
+    """Contiguous process->slice grouping (the simulated-pod topology
+    rule, shared with ``parallel/hierarchy.py``)."""
+    from sparknet_tpu.parallel.hierarchy import slice_members
+
+    return slice_members(nprocs, max(1, slices))
+
+
 def _spawn_local_procs(args, app_argv, collector) -> int:
+    import signal as _signal
+    import threading
+    import time
+
     port = free_port()
     repo = os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -115,12 +127,32 @@ def _spawn_local_procs(args, app_argv, collector) -> int:
             + os.environ.get("SPARKNET_EXTRA_XLA_FLAGS", "")
         ).strip(),
     }
-    import threading
+    # simulated-slice topology: contiguous process blocks; every child
+    # learns its slice through SPARKNET_SLICE_ID (the membership
+    # controller's SIGTERM hook marks THAT slice leaving)
+    slices = proc_slice_members(args.nprocs, getattr(args, "slices", 1))
+    slice_of = {
+        pid: i for i, members in enumerate(slices) for pid in members
+    }
+    # flag validation BEFORE any child spawns: a bad --preempt_slice
+    # must not leave nprocs orphaned training processes behind an
+    # early return
+    if getattr(args, "preempt_slice", None) is not None and not (
+        0 <= args.preempt_slice < len(slices)
+    ):
+        print(
+            f"launch: --preempt_slice={args.preempt_slice} out of "
+            f"range (have {len(slices)} slice(s))",
+            file=sys.stderr,
+        )
+        return 2
 
     procs = []
     outputs = []
     readers = []
-    for pid in range(args.nprocs):
+    preempt_killed = set()
+
+    def spawn(pid: int, relaunched: bool = False):
         cmd = [
             sys.executable,
             "-m",
@@ -131,10 +163,14 @@ def _spawn_local_procs(args, app_argv, collector) -> int:
             args.app,
             *app_argv,
         ]
-        env = env_base
+        env = {**env_base, "SPARKNET_SLICE_ID": str(slice_of[pid])}
         if collector is not None:
-            # each simulated host gets a stable fleet identity
-            env = {**env_base, "SPARKNET_HOST_ID": f"host{pid}"}
+            # each simulated host gets a stable fleet identity —
+            # STABLE across a relaunch, so the collector sees the same
+            # host come back with a new boot_id (restart detection)
+            env["SPARKNET_HOST_ID"] = f"host{pid}"
+        if relaunched:
+            env["SPARKNET_RELAUNCHED"] = "1"
         p = subprocess.Popen(
             cmd,
             env=env,
@@ -143,36 +179,130 @@ def _spawn_local_procs(args, app_argv, collector) -> int:
             text=True,
         )
         procs.append(p)
-        outputs.append([])
+        buf = []
+        outputs.append((pid, p, buf))
         # drain every child's pipe CONCURRENTLY — a sequential
         # communicate() deadlocks once any later child fills its 64KB
         # pipe while an earlier one waits on it in a collective
         t = threading.Thread(
-            target=lambda p=p, buf=outputs[-1]: buf.extend(p.stdout),
+            target=lambda p=p, buf=buf: buf.extend(p.stdout),
             name=f"launch-drain-p{pid}",
             daemon=True,
         )
         t.start()
         readers.append(t)
+        return p
+
+    for pid in range(args.nprocs):
+        spawn(pid)
+
+    # slice-granular lifecycle: --preempt_slice kills a WHOLE simulated
+    # slice (SIGTERM — the orchestrator's preemption notice) at
+    # --preempt_at seconds and relaunches the same processes
+    # --relaunch_after seconds later, same argv + SPARKNET_RELAUNCHED=1
+    # — the launcher-level half of "train through a preempted slice"
+    preempt_thread = None
+    t_end = time.time() + args.timeout
+    if getattr(args, "preempt_slice", None) is not None:
+        members = slices[args.preempt_slice]
+
+        def do_preempt():
+            time.sleep(args.preempt_at)
+            victims = [
+                (pid, p) for pid, p, _ in list(outputs)
+                if pid in members and p.poll() is None
+            ]
+            if not victims:
+                # the run finished (or died) before the scheduled
+                # preemption: there is nothing to preempt, and
+                # relaunching would re-run the whole app from scratch
+                # into a completed run's accounting
+                print(
+                    "launch: slice %d preemption skipped (no live "
+                    "process in the slice)" % args.preempt_slice
+                )
+                return
+            for pid, p in victims:
+                preempt_killed.add(p.pid)
+                p.send_signal(_signal.SIGTERM)
+            print(
+                "launch: slice %d preempted (SIGTERM to host(s) %s)"
+                % (args.preempt_slice, sorted(pid for pid, _ in victims))
+            )
+            time.sleep(args.relaunch_after)
+            if time.time() >= t_end:
+                # the global deadline passed while we slept: the main
+                # loop has killed everything and moved on — spawning
+                # now would orphan fresh children behind its back
+                print(
+                    "launch: slice %d relaunch skipped (run deadline "
+                    "passed)" % args.preempt_slice
+                )
+                return
+            # orchestrator escalation: a victim that treated the
+            # SIGTERM as a notice and kept running (--elastic children
+            # do) is hard-killed and REAPED before its replacement
+            # takes the same --process_id/coordinator identity — two
+            # live children with one identity would wedge the join
+            for pid, p in victims:
+                if p.poll() is None:
+                    p.kill()
+            for pid, p in victims:
+                try:
+                    p.wait(timeout=30)
+                # sparknet: except-ok(best-effort reap of a just-killed victim; the main wait loop owns final reaping and rc accounting)
+                except Exception:  # noqa: BLE001
+                    pass
+            for pid in members:
+                spawn(pid, relaunched=True)
+            print(
+                "launch: slice %d relaunched (host(s) %s)"
+                % (args.preempt_slice, sorted(members))
+            )
+
+        preempt_thread = threading.Thread(
+            target=do_preempt, name="launch-preempt", daemon=True
+        )
+        preempt_thread.start()
 
     rc = 0
-    deadline = args.timeout
-    for pid, p in enumerate(procs):
-        try:
-            p.wait(timeout=deadline)
-        except subprocess.TimeoutExpired:
-            for q in procs:
+    waited = 0
+    while True:
+        # procs may GROW (a relaunched slice): keep waiting until every
+        # spawned process — original and relaunched — has exited
+        current = list(procs)
+        for p in current[waited:]:
+            try:
+                p.wait(timeout=max(1, t_end - time.time()))
+            except subprocess.TimeoutExpired:
+                for q in list(procs):
+                    if q.poll() is None:
+                        q.kill()
+                rc = 1
+        waited = len(current)
+        if preempt_thread is not None and preempt_thread.is_alive():
+            preempt_thread.join(timeout=max(1, t_end - time.time()))
+        if time.time() >= t_end:
+            # global deadline: nothing further may spawn — reap and go
+            for q in list(procs):
                 if q.poll() is None:
                     q.kill()
-            rc = 1
+                    rc = rc or 1
+            break
+        if len(procs) == waited and (
+            preempt_thread is None or not preempt_thread.is_alive()
+        ):
+            break
     for t in readers:
         t.join(timeout=30)
-    for pid, (p, buf) in enumerate(zip(procs, outputs)):
+    for pid, p, buf in outputs:
         prefix = f"[host {pid}] "
         sys.stdout.write(
             "".join(prefix + line.rstrip("\n") + "\n" for line in buf)
         )
-        if p.returncode != 0:
+        if p.returncode != 0 and p.pid not in preempt_killed:
+            # a deliberately-preempted incarnation's kill rc is the
+            # fault we injected, not a failure
             rc = rc or p.returncode or 1
     if collector is not None:
         view = collector.fleet_view()
@@ -226,6 +356,30 @@ def main(argv=None) -> int:
         "it (appends --ship_to to each app argv); prints the merged "
         "live/late/dead summary at the end.  Real clusters pass the "
         "apps' own --fleet_collector/--ship_to flags instead",
+    )
+    parser.add_argument(
+        "--slices", type=int, default=1,
+        help="simulation mode: group the --nprocs processes into N "
+        "contiguous simulated TPU slices (each child learns its slice "
+        "via SPARKNET_SLICE_ID; pairs with the apps' --slices/"
+        "--cross_slice_every two-tier averaging flags)",
+    )
+    parser.add_argument(
+        "--preempt_slice", type=int, default=None, metavar="IDX",
+        help="simulation mode: SIGTERM every process of slice IDX at "
+        "--preempt_at seconds (the orchestrator's preemption notice) "
+        "and relaunch them --relaunch_after seconds later with "
+        "SPARKNET_RELAUNCHED=1 — kill and relaunch a whole simulated "
+        "slice mid-run",
+    )
+    parser.add_argument(
+        "--preempt_at", type=float, default=5.0,
+        help="seconds into the run at which --preempt_slice fires",
+    )
+    parser.add_argument(
+        "--relaunch_after", type=float, default=5.0,
+        help="seconds after the preemption at which the slice's "
+        "processes are relaunched",
     )
     parser.add_argument(
         "--coordinator", default=None, help="host:port of process 0"

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import enum
 import signal
-from typing import Optional
+from typing import Callable, List, Optional
 
 
 class SolverAction(enum.Enum):
@@ -24,12 +24,40 @@ class SolverAction(enum.Enum):
     SNAPSHOT = 2
 
 
+# SIGTERM preemption hooks: the orchestrator's preemption notice
+# arrives as SIGTERM, and subscribers (the elastic membership
+# controller, runtime/membership.py) want to KNOW without the process
+# acting on it — a preempted slice marks itself `leaving` and the job
+# trains on.  Hooks fire from any installed SignalHandler's SIGTERM
+# path; they must be signal-safe (set a flag, append to a list — no
+# locks, no I/O).  A SignalHandler built with ``sigterm_hooks=True``
+# installs the SIGTERM handler even when its effect is NONE, purely to
+# deliver these callbacks.
+_sigterm_hooks: List[Callable[[], None]] = []
+
+
+def add_sigterm_hook(fn: Callable[[], None]) -> Callable[[], None]:
+    """Subscribe ``fn`` to SIGTERM deliveries; returns ``fn`` so the
+    caller can hand it back to ``remove_sigterm_hook``."""
+    _sigterm_hooks.append(fn)
+    return fn
+
+
+def remove_sigterm_hook(fn: Callable[[], None]) -> None:
+    """Unsubscribe (idempotent — a hook already removed is a no-op)."""
+    try:
+        _sigterm_hooks.remove(fn)
+    except ValueError:
+        pass
+
+
 class SignalHandler:
     def __init__(
         self,
         sigint_effect: SolverAction = SolverAction.STOP,
         sighup_effect: SolverAction = SolverAction.SNAPSHOT,
         sigterm_effect: SolverAction = SolverAction.NONE,
+        sigterm_hooks: bool = False,
     ):
         self._effects = {}
         self._flags = {SolverAction.STOP: False, SolverAction.SNAPSHOT: False}
@@ -39,8 +67,12 @@ class SignalHandler:
             (signal.SIGHUP, sighup_effect),
             (signal.SIGTERM, sigterm_effect),
         ):
-            if effect != SolverAction.NONE:
-                self._effects[sig] = effect
+            want = effect != SolverAction.NONE or (
+                sig == signal.SIGTERM and sigterm_hooks
+            )
+            if want:
+                if effect != SolverAction.NONE:
+                    self._effects[sig] = effect
                 self._prev[sig] = signal.signal(sig, self._handle)
 
     def _handle(self, signum, frame):
@@ -55,6 +87,14 @@ class SignalHandler:
             from sparknet_tpu.obs import flight as _flight
 
             _flight.dump_if_active("signal_SIGTERM")
+            # preemption-notice subscribers (elastic membership): each
+            # hook guarded — a bad subscriber must not break the
+            # stop/snapshot contract of the handler itself
+            for fn in list(_sigterm_hooks):
+                try:
+                    fn()
+                except Exception:  # noqa: BLE001 — signal context
+                    pass
 
     def get_action(self) -> SolverAction:
         """Poll-and-clear, highest priority first (STOP beats SNAPSHOT)."""

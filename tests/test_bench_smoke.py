@@ -122,6 +122,7 @@ def test_unknown_mode_rejected():
     assert "sanitize" in out.stderr  # ... and the invariant-sanitizer mode
     assert "fleet" in out.stderr  # ... and the fleet-observability mode
     assert "delivery" in out.stderr  # ... and the serving-fleet delivery mode
+    assert "elastic" in out.stderr  # ... and the elastic-membership mode
     # env-var route rejects identically
     out = subprocess.run(
         [sys.executable, os.path.join(_REPO, "bench.py")],
@@ -436,7 +437,7 @@ def test_perf_gate_passes_over_committed_artifacts():
     gated = {r["family"] for r in rows}
     for fam in (
         "PIPELINE", "OBS", "HEALTH", "CHAOS", "SERVE", "PROFILE",
-        "DATACACHE", "SANITIZE", "FLEET", "DELIVERY",
+        "DATACACHE", "SANITIZE", "FLEET", "DELIVERY", "ELASTIC",
     ):
         assert fam in gated, fam
 
@@ -507,23 +508,28 @@ _CHAOS_SCHEMA_KEYS = (
     "faults_survived", "faults", "recovery_latency_s", "resumed_from_iter",
     "quarantined", "final_loss", "baseline_final_loss", "loss_band",
     "loss_band_ok", "final_iter", "seed", "workers", "rounds", "tau",
-    "cache_stats", "collector_outage",
+    "cache_stats", "collector_outage", "slice_preempt_round",
+    "slice_leave_round", "slice_rejoin_round", "slice_masked_rounds",
+    "membership",
 )
 
 
 def test_committed_chaos_artifact_schema():
-    """CHAOS_r15.json — the fault-tolerance committed artifact: every
+    """CHAOS_r16.json — the fault-tolerance committed artifact: every
     injected fault survived (the ISSUE 2 done-bar), every fault CLASS
     fired — including the round-12 data-plane faults (cache entry
     corrupted -> quarantined + refetched; cache wiped cold ->
     refilled), the round-14 fleet-plane collector outage (pushes
-    failed while down, buffered events replayed with 0 lost), and the
+    failed while down, buffered events replayed with 0 lost), the
     round-15 serving-fleet faults (a replica hard-killed mid-traffic
     ejected + respawned with zero client errors; a corrupt publish
-    rejected at CRC verify, never canaried) — the run resumed from an
-    OLDER verified snapshot after the newest was corrupted+quarantined,
-    and the final loss sat inside the no-fault run's band."""
-    with open(os.path.join(_REPO, "CHAOS_r15.json")) as f:
+    rejected at CRC verify, never canaried), and the round-16 slice
+    preemption (a whole slice SIGTERM'd, departing at exactly the next
+    round boundary, training masked, rejoining via snapshot ->
+    broadcast) — the run resumed from an OLDER verified snapshot after
+    the newest was corrupted+quarantined, and the final loss sat
+    inside the no-fault run's band."""
+    with open(os.path.join(_REPO, "CHAOS_r16.json")) as f:
         d = json.load(f)
     for key in _CHAOS_SCHEMA_KEYS:
         assert key in d, key
@@ -537,10 +543,21 @@ def test_committed_chaos_artifact_schema():
         "dead_worker", "nan_injection", "straggler_injection",
         "cache_corruption", "cache_cold", "collector_outage",
         "replica_death", "published_snapshot_corrupt",
+        "slice_preemption",
     ):
         v = d["faults"][kind]
         assert v["injected"] >= 1, kind
         assert v["survived"] == v["injected"], (kind, v)
+    # the slice preemption's leave landed at EXACTLY the boundary after
+    # the SIGTERM, the masked rounds cover the departed span, and the
+    # final membership view is fully live again
+    assert d["slice_leave_round"] == d["slice_preempt_round"] + 1
+    assert d["slice_rejoin_round"] is not None
+    assert set(d["slice_masked_rounds"]) >= set(
+        range(d["slice_leave_round"], d["slice_rejoin_round"])
+    )
+    assert all(s == "live" for s in d["membership"]["states"])
+    assert d["membership"]["epoch"] >= 3  # leave -> death -> join -> rejoin
     out = d["collector_outage"]
     assert out["push_failures"] > 0
     assert out["events_lost"] == 0 and out["events_dropped"] == 0
@@ -1002,3 +1019,79 @@ def test_committed_delivery_artifact_schema():
     assert d["replica_kill_respawned"] is True
     assert d["replica_kill_client_errors"] == 0
     assert d["replica_kill_ok"] is True
+
+
+@pytest.mark.slow
+def test_elastic_mode_smoke():
+    """bench.py --mode=elastic end to end in a subprocess: flat-spec
+    bit identity, the SIGTERM'd slice departing at exactly the next
+    boundary and rejoining, and the measured K x cross-slice byte
+    reduction."""
+    rec = _run_bench({
+        "BENCH_MODE": "elastic", "BENCH_ELASTIC_ROUNDS": "8",
+        "BENCH_CROSS_EVERY": "2", "BENCH_BYTE_ROUNDS": "4",
+    })
+    assert rec["metric"] == "elastic_cross_slice_bytes_ratio"
+    assert rec["flat_bit_identical"] is True
+    assert rec["departure_detected_exact"] is True
+    assert rec["rejoin_completed"] is True
+    assert rec["views_monotonic"] is True
+    assert rec["loss_band_ok"] is True
+    assert rec["cross_bytes_ratio"] >= rec["cross_slice_every"] * 0.95
+
+
+_ELASTIC_SCHEMA_KEYS = (
+    "metric", "value", "unit", "vs_baseline", "platform", "workers",
+    "tau", "batch", "rounds", "slices", "cross_slice_every",
+    "flat_bit_identical", "flat_identity_rounds", "preempt_round",
+    "departure_detected_round", "departure_detected_exact",
+    "slice_masked_rounds", "rejoin_round", "rejoin_completed",
+    "views_monotonic", "membership_epochs", "membership_transitions",
+    "final_loss", "baseline_final_loss", "loss_band", "loss_band_ok",
+    "byte_rounds", "cross_bytes_flat", "cross_bytes_two_tier",
+    "cross_bytes_ratio", "intra_bytes_flat", "intra_bytes_two_tier",
+    "note",
+)
+
+
+def test_committed_elastic_artifact_schema():
+    """ELASTIC_r16.json — the elastic-membership + two-tier hierarchy
+    committed artifact (ISSUE 13 done-bars): a flat HierarchySpec's
+    round bit-identical to the single-tier round, the preempted
+    slice's departure detected at EXACTLY the next round boundary,
+    every intervening round masked, the rejoin completing with
+    monotonic view epochs, the final loss inside the no-fault band,
+    and the two-tier schedule's measured cross-slice bytes ~K x below
+    the every-round flat run."""
+    with open(os.path.join(_REPO, "ELASTIC_r16.json")) as f:
+        d = json.load(f)
+    for key in _ELASTIC_SCHEMA_KEYS:
+        assert key in d, key
+    assert d["metric"] == "elastic_cross_slice_bytes_ratio"
+    assert d["value"] == d["cross_bytes_ratio"] > 1.0
+    assert d["flat_bit_identical"] is True
+    # departure at the boundary right after the SIGTERM notice
+    assert d["departure_detected_round"] == d["preempt_round"] + 1
+    assert d["departure_detected_exact"] is True
+    # the departed span was masked every round until the rejoin
+    assert set(d["slice_masked_rounds"]) >= set(
+        range(d["departure_detected_round"], d["rejoin_round"])
+    )
+    assert d["rejoin_completed"] is True
+    assert d["views_monotonic"] is True
+    # leave -> death -> join_request -> rejoin, epochs monotonic
+    kinds = [t[2] for t in d["membership_transitions"]]
+    assert kinds == ["leave", "death", "join_request", "rejoin"]
+    epochs = [t[0] for t in d["membership_transitions"]]
+    assert epochs == sorted(epochs)
+    assert d["loss_band_ok"] is True
+    assert abs(d["final_loss"] - d["baseline_final_loss"]) <= (
+        d["loss_band"]
+    )
+    # modeled bytes: the reduction tracks K exactly (cross rounds run
+    # 1/K as often; the note discloses the modeled-bytes convention)
+    assert d["cross_bytes_ratio"] >= d["cross_slice_every"] * 0.95
+    assert d["cross_bytes_flat"] > d["cross_bytes_two_tier"] > 0
+    assert d["intra_bytes_flat"] == 0  # K=1: every round is cross
+    assert d["intra_bytes_two_tier"] > 0
+    assert "modeled" in d["note"].lower()

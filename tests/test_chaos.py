@@ -55,6 +55,27 @@ def test_default_plan_covers_every_fault_class():
     assert plan.replica_death_round > plan.preempt_round
     assert plan.publish_corrupt_round is not None
     assert plan.publish_corrupt_round > plan.replica_death_round
+    # the slice preemption (round 16): the SIGTERM notice fires BEFORE
+    # the SIGHUP process death (the leave must land pre-resume so the
+    # replay can't re-fire it), the preempted slice is a real
+    # multi-worker group, and the rejoin lands inside the run
+    assert plan.slice_preempt_round is not None
+    assert plan.slice_preempt_round < plan.preempt_round
+    assert plan.membership_slices >= 2
+    assert plan.cross_slice_every >= 2  # the two-tier schedule is on
+    from sparknet_tpu.parallel.hierarchy import HierarchySpec
+
+    spec = HierarchySpec.grouped(
+        plan.workers, plan.membership_slices, plan.cross_slice_every
+    )
+    assert len(spec.slices[plan.slice_preempt_slice]) >= 2
+    # the dead-worker fault targets a DIFFERENT slice, so the two
+    # masking channels stay attributable
+    assert plan.dead_worker not in spec.slices[plan.slice_preempt_slice]
+    assert (
+        plan.slice_preempt_round + plan.slice_relaunch_delta
+        < plan.rounds
+    )
 
 
 def test_no_fault_view_strips_all_faults():
@@ -67,7 +88,12 @@ def test_no_fault_view_strips_all_faults():
     assert base.cache_cold_round is None
     assert base.replica_death_round is None
     assert base.publish_corrupt_round is None
-    # run geometry unchanged: the baseline is comparable
+    assert base.slice_preempt_round is None
+    # run geometry unchanged: the baseline is comparable — including
+    # the two-tier hierarchy shape (both legs run the same schedule)
+    plan2 = chaos.FaultPlan.default()
+    assert base.membership_slices == plan2.membership_slices
+    assert base.cross_slice_every == plan2.cross_slice_every
     plan = chaos.FaultPlan.default()
     for f in ("seed", "workers", "rounds", "tau", "batch"):
         assert getattr(base, f) == getattr(plan, f)
@@ -223,6 +249,17 @@ def test_chaos_smoke_default_plan(tmp_path):
     assert any(
         f.endswith(".corrupt") for f in os.listdir(pub_dir)
     ), "rejected publish must be quarantined on disk"
+
+    # the slice preemption (round 16): leave at exactly the boundary
+    # after the SIGTERM, every departed round masked, rejoin completed
+    # with the roster fully live and monotonic epochs
+    assert rep["faults"]["slice_preemption"]["survived"] == 1
+    assert rep["slice_leave_round"] == rep["slice_preempt_round"] + 1
+    assert rep["slice_rejoin_round"] is not None
+    assert set(rep["slice_masked_rounds"]) >= set(
+        range(rep["slice_leave_round"], rep["slice_rejoin_round"])
+    )
+    assert all(s == "live" for s in rep["membership"]["states"])
 
     # quarantined files really are on disk, out of the resume scan
     corrupt = [f for f in os.listdir(str(tmp_path)) if f.endswith(".corrupt")]

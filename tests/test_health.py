@@ -299,7 +299,8 @@ def test_single_masked_worker_is_absorbed_not_escalated():
     the run (escalation is for poison that reached the average)."""
 
     class _StubTrainer:
-        def round(self, state, batches, rng=None, live_mask=None):
+        def round(self, state, batches, rng=None, live_mask=None,
+                  round_index=None):
             return (
                 "NEXT",
                 np.asarray([[1.0], [np.nan]]),

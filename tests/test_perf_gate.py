@@ -272,6 +272,54 @@ def test_delivery_family_rules(tmp_path):
         ), (bad_field, rows)
 
 
+GOOD_ELASTIC = {
+    "value": 4.0, "flat_bit_identical": True,
+    "departure_detected_exact": True, "rejoin_completed": True,
+    "views_monotonic": True, "loss_band_ok": True,
+    "cross_bytes_ratio": 4.0, "cross_slice_every": 4,
+}
+
+
+def test_elastic_family_rules(tmp_path):
+    """The ELASTIC family (ISSUE 13): flat-spec bit identity, exact
+    departure detection at the round boundary, completed rejoin with
+    monotonic view epochs, loss in the no-fault band, and the ~K x
+    cross-slice byte reduction — any one regressing fails --check."""
+    g = _gate()
+    _write(tmp_path, "ELASTIC_r16.json", GOOD_ELASTIC)
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 0, rows
+    for bad_field, bad_value in (
+        ("flat_bit_identical", False),     # flat spec drifted bitwise
+        ("departure_detected_exact", False),  # leave landed off-boundary
+        ("rejoin_completed", False),       # roster never fully live again
+        ("views_monotonic", False),        # epochs went backwards
+        ("loss_band_ok", False),           # preemption cost accuracy
+        ("cross_bytes_ratio", 2.0),        # two-tier stopped amortizing
+    ):
+        _write(
+            tmp_path, "ELASTIC_r17.json",
+            dict(GOOD_ELASTIC, **{bad_field: bad_value}),
+        )
+        rc, rows = g.check(str(tmp_path))
+        assert rc == 1, bad_field
+        assert any(
+            bad_field in r["detail"] for r in rows if not r["ok"]
+        ), (bad_field, rows)
+    # the K-relative extra rule: a ratio far under the artifact's OWN
+    # K fails even if it clears the static 3.9 floor
+    _write(
+        tmp_path, "ELASTIC_r17.json",
+        dict(GOOD_ELASTIC, cross_slice_every=8, cross_bytes_ratio=4.0,
+             value=4.0),
+    )
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 1
+    assert any(
+        "cross_slice_every" in r["detail"] for r in rows if not r["ok"]
+    )
+
+
 def test_missing_key_is_a_failure_not_a_pass(tmp_path):
     g = _gate()
     _write(tmp_path, "OBS_r09.json", {"overhead_traced_pct": 0.5})

@@ -192,6 +192,24 @@ RULES: Dict[str, List[Rule]] = {
         Rule("replica_kill_ok", "is", True),
         Rule("replica_kill_client_errors", "==", 0),
     ],
+    "ELASTIC": [
+        # the elastic membership + two-tier hierarchy contract
+        # (bench.py --mode=elastic): a flat HierarchySpec's round
+        # bit-identical to the single-tier round, the SIGTERM'd
+        # slice's departure landing at EXACTLY the next round
+        # boundary, the rejoin completing (whole roster live, views
+        # monotonic), the faulted run's final loss inside the no-fault
+        # band, and the two-tier schedule's measured cross-slice
+        # bytes ~K x below the every-round flat run (K=4 committed;
+        # the K-relative band is the extra rule below)
+        Rule("value", ">", 1.0),
+        Rule("flat_bit_identical", "is", True),
+        Rule("departure_detected_exact", "is", True),
+        Rule("rejoin_completed", "is", True),
+        Rule("views_monotonic", "is", True),
+        Rule("loss_band_ok", "is", True),
+        Rule("cross_bytes_ratio", ">=", 3.9),
+    ],
     "DATACACHE": [
         # the I/O-flat contract: a warm (cache-filled, shuffled-
         # assignment) epoch makes ZERO network fetches and is strictly
@@ -263,9 +281,21 @@ def _pipeline_order_rule(art: dict) -> Tuple[bool, str]:
     )
 
 
+def _elastic_ratio_rule(art: dict) -> Tuple[bool, str]:
+    """The cross-slice byte reduction must track the artifact's OWN K
+    (cross_slice_every), whatever K the bench ran with."""
+    k = art.get("cross_slice_every") or 0
+    ratio = art.get("cross_bytes_ratio") or 0
+    ok = bool(k and ratio >= k * 0.95)
+    return ok, (
+        "cross_bytes_ratio=%r >= 0.95*cross_slice_every=%r" % (ratio, k)
+    )
+
+
 _EXTRA_RULES = {
     "CHAOS": [_chaos_survival_rule],
     "PIPELINE": [_pipeline_order_rule],
+    "ELASTIC": [_elastic_ratio_rule],
 }
 
 
