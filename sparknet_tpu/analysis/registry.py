@@ -88,6 +88,11 @@ CANONICAL_METRICS = {
     "sparknet_delivery_promotions_total": (),
     "sparknet_delivery_rollbacks_total": (),
     "sparknet_delivery_divergence": (),
+    # run journal + crash recovery (io/journal.py, --journal;
+    # runtime/recover.py journaled resume)
+    "sparknet_journal_records_total": ("kind",),
+    "sparknet_journal_truncated_total": (),
+    "sparknet_recover_replayed_rounds_total": (),
     # fleet collector (obs/fleet.py, --fleet_collector) — the merged
     # cross-host families on the collector's own /metrics
     "sparknet_fleet_hosts": ("state",),

@@ -40,11 +40,13 @@ def main(argv=None) -> int:
         "transfers collapse throughput (PERF.md)",
     )
     from sparknet_tpu import obs
+    from sparknet_tpu.io import journal as journal_mod
     from sparknet_tpu.parallel import comm, hierarchy
 
     obs.add_cli_args(parser)  # --obs / --obs_port / --trace_out
     comm.add_cli_args(parser)  # --compress / --overlap_avg
     hierarchy.add_cli_args(parser)  # --slices/--cross_slice_every/--elastic
+    journal_mod.add_cli_args(parser)  # --journal / --no_journal / ...
     args = parser.parse_args(argv)
 
     import jax
@@ -192,6 +194,11 @@ def main(argv=None) -> int:
     # executes (RoundFeed; --serial_feed restores the old serial path
     # with identical numerics)
     run_obs = obs.start_from_args(args, echo=log.log)
+    # --journal: the round ledger (io/journal.py).  This app keeps no
+    # snapshots, so commits mark in-memory round completion only
+    # (durable=False) — a progress/postmortem record carrying the view
+    # epoch; the resume-capable drivers attach snapshot refs.
+    jr = journal_mod.journal_from_args(args, "cifar_run.journal")
     # timed_worker_windows: with --profile the per-worker draw times
     # feed the round profiler's straggler attribution (plain list
     # comprehension otherwise)
@@ -222,6 +229,14 @@ def main(argv=None) -> int:
                     # land any in-flight overlapped average before scoring
                     state = trainer.finalize(state)
                     log.log(f"round {r}, accuracy {evaluate(r):.4f}")
+                if jr is not None:
+                    jr.begin_round(
+                        r, iter=r * args.tau, cursor=r,
+                        view_epoch=(
+                            membership_ctl.view.epoch
+                            if membership_ctl is not None else 0
+                        ),
+                    )
                 mask = None
                 if membership_ctl is not None:
                     # roster changes land at the round boundary; a
@@ -254,6 +269,10 @@ def main(argv=None) -> int:
                 log.log(
                     f"round {r} trained, smoothed_loss {solver.smoothed_loss:.4f}"
                 )
+                if jr is not None:
+                    jr.commit_round(
+                        r, iter=(r + 1) * args.tau, durable=False
+                    )
         state = trainer.finalize(state)  # last round's average lands
         log.log(f"final accuracy {evaluate():.4f}")
         return 0
@@ -263,6 +282,8 @@ def main(argv=None) -> int:
     finally:
         if membership_ctl is not None:
             membership_ctl.detach()
+        if jr is not None:
+            jr.close()
         feed.stop()
         run_obs.close()
         log.close()

@@ -59,11 +59,13 @@ def main(argv=None) -> int:
         "links)",
     )
     from sparknet_tpu import obs
+    from sparknet_tpu.io import journal as journal_mod
     from sparknet_tpu.parallel import comm, hierarchy
 
     obs.add_cli_args(parser)  # --obs / --obs_port / --trace_out
     comm.add_cli_args(parser)  # --compress / --overlap_avg
     hierarchy.add_cli_args(parser)  # --slices / --cross_slice_every / --elastic
+    journal_mod.add_cli_args(parser)  # --journal / --no_journal / ...
     args = parser.parse_args(argv)
 
     import jax
@@ -150,6 +152,12 @@ def main(argv=None) -> int:
         return stack_windows(windows, out)
 
     run_obs = obs.start_from_args(args, echo=log.log)
+    # --journal: the round ledger (io/journal.py).  This app keeps no
+    # snapshots, so commits mark in-memory round completion only
+    # (durable=False) — a progress/postmortem record, not a resume
+    # target; the resume-capable drivers (cli train,
+    # imagenet_run_db_app) attach snapshot refs.
+    jr = journal_mod.journal_from_args(args, "cifar_db_run.journal")
     feed = RoundFeed(
         assemble,
         mesh=mesh,
@@ -158,6 +166,8 @@ def main(argv=None) -> int:
     )
     try:
         for r in range(args.rounds):
+            if jr is not None:
+                jr.begin_round(r, iter=r * args.tau, cursor=r)
             if sentry is not None:
                 state, _ = sentry.guarded_round(
                     trainer, state, feed.next_round(r), round_index=r
@@ -169,6 +179,8 @@ def main(argv=None) -> int:
             log.log(
                 f"round {r} trained, smoothed_loss {solver.smoothed_loss:.4f}"
             )
+            if jr is not None:
+                jr.commit_round(r, iter=(r + 1) * args.tau, durable=False)
 
         state = trainer.finalize(state)  # last round's average lands
         # eval from the test DB
@@ -194,6 +206,8 @@ def main(argv=None) -> int:
     finally:
         # telemetry closes AFTER the final-accuracy line so the JSONL
         # run log carries the run's headline result too
+        if jr is not None:
+            jr.close()
         feed.stop()
         run_obs.close()
         log.close()

@@ -113,11 +113,13 @@ def main(argv=None) -> int:
         "behavior)",
     )
     from sparknet_tpu import obs
+    from sparknet_tpu.io import journal as journal_mod
     from sparknet_tpu.parallel import comm, hierarchy
 
     obs.add_cli_args(parser)  # --obs / --obs_port / --trace_out
     comm.add_cli_args(parser)  # --compress / --overlap_avg
     hierarchy.add_cli_args(parser)  # --slices / --cross_slice_every / --elastic
+    journal_mod.add_cli_args(parser)  # --journal / --no_journal / ...
     args = parser.parse_args(argv)
 
     import jax
@@ -430,6 +432,10 @@ def main(argv=None) -> int:
         pipelined=not args.serial_feed,
         num_rounds=args.rounds,
     )
+    # --journal: the round ledger (io/journal.py).  This app keeps no
+    # snapshots, so commits mark in-memory round completion only
+    # (durable=False); the resume-capable drivers attach snapshot refs.
+    jr = journal_mod.journal_from_args(args, "imagenet_run.journal")
     try:
         for r in range(args.rounds):
             if r % args.test_every == 0:  # test-then-train, ImageNetApp.scala:118
@@ -437,6 +443,8 @@ def main(argv=None) -> int:
                 state = trainer.finalize(state)
                 log.log(f"{evaluate(r) * 100:.2f}% accuracy", i=r)
             log.log("training", i=r)
+            if jr is not None:
+                jr.begin_round(r, iter=r * args.tau, cursor=r)
             if sentry is not None:
                 state, _ = sentry.guarded_round(
                     trainer, state, feed.next_round(r), round_index=r
@@ -448,6 +456,8 @@ def main(argv=None) -> int:
             log.log(
                 f"trained, smoothed_loss {solver.smoothed_loss:.4f}", i=r
             )
+            if jr is not None:
+                jr.commit_round(r, iter=(r + 1) * args.tau, durable=False)
         state = trainer.finalize(state)  # last round's average lands
         acc = evaluate()
         log.log(f"final accuracy {acc * 100:.2f}%")
@@ -460,6 +470,8 @@ def main(argv=None) -> int:
     finally:
         # telemetry closes AFTER the final-accuracy line so the JSONL
         # run log carries the run's headline result too
+        if jr is not None:
+            jr.close()
         feed.stop()
         run_obs.close()
         log.close()

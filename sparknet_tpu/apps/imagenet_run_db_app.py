@@ -78,11 +78,13 @@ def main(argv=None) -> int:
         "boundaries)",
     )
     from sparknet_tpu import obs
+    from sparknet_tpu.io import journal as journal_mod
     from sparknet_tpu.parallel import comm, hierarchy
 
     obs.add_cli_args(parser)  # --obs / --obs_port / --trace_out
     comm.add_cli_args(parser)  # --compress / --overlap_avg
     hierarchy.add_cli_args(parser)  # --slices / --cross_slice_every / --elastic
+    journal_mod.add_cli_args(parser)  # --journal / --no_journal / ...
     args = parser.parse_args(argv)
 
     import jax
@@ -224,18 +226,51 @@ def main(argv=None) -> int:
         sentry.restore_fn = health_mod.make_restore_fn(
             solver, prefix, trainer=trainer
         )
+    # --journal: the crash-consistency round ledger beside the
+    # snapshots; a --resume that finds one consumes it automatically
+    # (ledger-guided rewind to the last COMMITTED boundary + the
+    # journaled driver state put back)
+    jr = journal_mod.journal_from_args(
+        args, journal_mod.default_journal_path(prefix),
+        resuming=args.resume,
+    )
+    if jr is not None:
+        log.log(f"run journal: {jr.path} (fsync={jr.fsync})")
     start_round = 0
     if args.resume:
         # fault-tolerant resume: CRC-verified, newest-valid-wins — a
         # corrupt/truncated newest snapshot (preemption mid-write) is
         # quarantined and the scan falls back to an older valid one
+        job_state = None
         try:
-            st, used = checkpoint.restore_newest_valid(solver, prefix)
+            if jr is not None and jr.last_committed_round is not None:
+                st, used, job_state, jinfo = (
+                    checkpoint.restore_newest_valid_journaled(
+                        solver, prefix, jr
+                    )
+                )
+                if jinfo["in_flight_round"] is not None:
+                    tm = obs.training_metrics()
+                    if tm is not None:
+                        tm.recover_replayed.inc()
+                    log.log(
+                        "journal: round %d was in flight at the crash "
+                        "— re-executing it" % jinfo["in_flight_round"]
+                    )
+            else:
+                st, used = checkpoint.restore_newest_valid(solver, prefix)
         except FileNotFoundError:
             raise SystemExit(f"--resume: no {prefix}_iter_*.solverstate*")
         except checkpoint.SnapshotCorrupt as e:
             raise SystemExit(f"--resume: {e}")
         state = _broadcast_state(trainer, st)
+        if job_state:
+            # driver-side state the snapshot's TrainState never
+            # carried: comm-plane EF residuals + sentry scalars
+            if "comm" in job_state:
+                trainer.restore_comm_state(job_state["comm"])
+            if sentry is not None and "sentry" in job_state:
+                sentry.load_state(job_state["sentry"])
         start_round = int(np.asarray(st.iter)) // args.tau
         log.log(f"resumed from {used} at round {start_round}")
     elif args.warm_start:
@@ -324,6 +359,10 @@ def main(argv=None) -> int:
                 state = trainer.finalize(state)
                 log.log(f"{evaluate() * 100:.2f}% accuracy", i=r)
             log.log("training", i=r)
+            if jr is not None:
+                # write-ahead intent: restart knows round r was in
+                # flight whatever happens next
+                jr.begin_round(r, iter=r * args.tau, cursor=r)
             if sentry is not None:
                 state, _ = sentry.guarded_round(
                     trainer, state, feed.next_round(r), round_index=r
@@ -338,9 +377,23 @@ def main(argv=None) -> int:
                 # mid-flight overlapped state
                 state = trainer.finalize(state)
                 st = first_worker(jax.device_get(state))
+                extra = {"cursor": {"round": r + 1}}
+                comm_state = trainer.export_comm_state()
+                if comm_state is not None:
+                    extra["comm"] = comm_state
+                if sentry is not None:
+                    extra["sentry"] = sentry.export_state()
                 model_path, state_path = checkpoint.snapshot(
-                    solver, st, prefix
+                    solver, st, prefix, extra_state=extra
                 )
+                if jr is not None:
+                    # the durable boundary: the commit rides the
+                    # published snapshot ref (exactly-once rewind
+                    # target for restore_newest_valid_journaled)
+                    jr.commit_round(
+                        r, iter=(r + 1) * args.tau,
+                        snapshot=os.path.basename(state_path),
+                    )
                 log.log(f"snapshot -> {model_path}", i=r)
 
         state = trainer.finalize(state)  # last round's average lands
@@ -356,6 +409,8 @@ def main(argv=None) -> int:
     finally:
         # telemetry closes AFTER the final-accuracy line so the JSONL
         # run log carries the run's headline result too
+        if jr is not None:
+            jr.close()
         feed.stop()
         run_obs.close()
         log.close()

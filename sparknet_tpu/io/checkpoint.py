@@ -26,6 +26,20 @@ falls back to the newest snapshot that verifies — preemption mid-write
 or bit-rot degrades to an older restore point instead of killing the
 resume (``imagenet_run_db_app --resume`` / ``cli train --resume``;
 chaos-proved by ``runtime/chaos.py``).
+
+Full job state (the crash-consistency layer): ``snapshot(...,
+extra_state=...)`` serializes DRIVER-side state the TrainState never
+carried — CommPlane error-feedback residuals, sentry EMA/cooldown,
+membership epoch, data-plane cursors — as
+``{prefix}_iter_{N}.jobstate.npz`` beside the model/state files, listed
+in the same CRC manifest (``load_job_state`` reads it back).
+``restore_newest_valid_journaled()`` reconciles the run journal
+(``io/journal.py``) against the snapshot set: it rewinds to the last
+COMMITTED round boundary — a snapshot published for a round whose
+commit never landed is ignored, so restart never re-executes a
+committed round nor skips an uncommitted one.  Proven bit-identical
+under SIGKILL at every phase boundary by ``bench.py --mode=recover``
+(``runtime/recover.py``).
 """
 
 from __future__ import annotations
@@ -40,6 +54,7 @@ from typing import List, Optional, Tuple
 _log = logging.getLogger(__name__)
 
 _STATE_SUFFIXES = (".solverstate.npz", ".solverstate.h5")
+_JOBSTATE_SUFFIX = ".jobstate.npz"
 
 
 class SnapshotCorrupt(RuntimeError):
@@ -64,12 +79,27 @@ def _flatten_history(history):
     return leaves, treedef
 
 
+# chaos/test seam: called with the DESTINATION path after the temp file
+# is fully written but before the atomic publish rename — the window a
+# preemption mid-write lands in.  The kill sweep's SIGKILL here leaves
+# an unpublished ``*.tmp-<pid>`` (never a torn published file);
+# in-process tests raise instead, exercising the clean-abandon path.
+_CRASH_HOOK = None
+
+
+def set_crash_hook(hook) -> None:
+    global _CRASH_HOOK
+    _CRASH_HOOK = hook
+
+
 def _atomic(write_fn, path: str) -> None:
     """Write through a temp file + rename so a kill mid-write never
     leaves a file ``restore()`` would accept."""
     tmp = f"{path}.tmp-{os.getpid()}"
     try:
         write_fn(tmp)
+        if _CRASH_HOOK is not None:
+            _CRASH_HOOK(path)
         os.replace(tmp, path)
     finally:
         if os.path.exists(tmp):
@@ -101,14 +131,29 @@ _crc32_file = crc32_file  # pre-round-15 private name, kept for callers
 def manifest_path_for(path: str) -> str:
     """``.../p_iter_N.<anything>`` -> ``.../p_iter_N.manifest.json``."""
     base = path
-    for suf in _STATE_SUFFIXES + (".caffemodel.h5", ".caffemodel"):
+    for suf in _STATE_SUFFIXES + (
+        _JOBSTATE_SUFFIX, ".caffemodel.h5", ".caffemodel"
+    ):
         if base.endswith(suf):
             base = base[: -len(suf)]
             break
     return base + ".manifest.json"
 
 
-def _write_manifest(it: int, fmt: str, paths: Tuple[str, str]) -> str:
+def jobstate_path_for(state_path: str) -> str:
+    """``.../p_iter_N.solverstate.*`` -> ``.../p_iter_N.jobstate.npz``."""
+    base = state_path
+    for suf in _STATE_SUFFIXES:
+        if base.endswith(suf):
+            base = base[: -len(suf)]
+            break
+    return base + _JOBSTATE_SUFFIX
+
+
+def _write_manifest(it: int, fmt: str, paths) -> str:
+    """CRC/size manifest over every published snapshot file (model,
+    state, and — when present — the jobstate companion).  The state
+    path sits at index 1; extra files follow."""
     mpath = manifest_path_for(paths[1])
     entries = {}
     for p in paths:
@@ -212,17 +257,96 @@ def verify_snapshot(state_path: str) -> None:
     verify_manifest(manifest_path_for(state_path))
 
 
+# ----------------------------------------------------------------------
+# full job state: the driver-side state a TrainState never carried
+# (CommPlane EF residuals, sentry EMA/cooldown, membership epoch,
+# data-plane cursors), serialized beside params under the same CRC
+# manifest.  The payload is a NESTED dict whose leaves are numpy arrays
+# (stored as npz entries keyed by their "/"-joined path) or JSON-able
+# scalars/lists (stored together in one __json__ entry).
+
+
+def _flatten_job_state(d: dict, prefix: str = ""):
+    for k, v in d.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            yield from _flatten_job_state(v, key + "/")
+        else:
+            yield key, v
+
+
+def _unflatten_job_state(flat: dict) -> dict:
+    out: dict = {}
+    for key, v in flat.items():
+        parts = key.split("/")
+        cur = out
+        for p in parts[:-1]:
+            cur = cur.setdefault(p, {})
+        cur[parts[-1]] = v
+    return out
+
+
+def _dump_job_state(path: str, extra_state: dict) -> None:
+    import json as _json
+
+    arrays = {}
+    scalars = {}
+    for key, v in _flatten_job_state(extra_state):
+        if hasattr(v, "shape") and hasattr(v, "dtype"):
+            arrays[f"a:{key}"] = np.asarray(v)
+        else:
+            scalars[key] = v
+
+    def _savez(p):
+        with open(p, "wb") as f:
+            np.savez(
+                f,
+                __json__=np.frombuffer(
+                    _json.dumps(scalars).encode("utf-8"), np.uint8
+                ),
+                **arrays,
+            )
+
+    _atomic(_savez, path)
+
+
+def load_job_state(state_path: str):
+    """The jobstate companion of a snapshot (pass the solverstate
+    path), or None when the snapshot predates the job-state format.
+    Read-only; the manifest check happens in ``restore()``/``verify``.
+    """
+    import json as _json
+
+    jpath = jobstate_path_for(state_path)
+    if not os.path.exists(jpath):
+        return None
+    flat: dict = {}
+    with np.load(jpath) as z:
+        for name in z.files:
+            if name == "__json__":
+                flat.update(
+                    _json.loads(bytes(z[name].tobytes()).decode("utf-8"))
+                )
+            elif name.startswith("a:"):
+                flat[name[2:]] = z[name]
+    return _unflatten_job_state(flat)
+
+
 def _write_snapshot(
-    fmt: str, prefix: str, it: int, blobs, leaves, net_name: str
+    fmt: str, prefix: str, it: int, blobs, leaves, net_name: str,
+    extra_state=None,
 ) -> Tuple[str, str]:
     """Host-side file writes of one snapshot (shared by the sync path
     and the AsyncCheckpointer worker); all files publish atomically."""
     with obs.span("snapshot", iter=int(it), fmt=fmt):
-        return _write_snapshot_inner(fmt, prefix, it, blobs, leaves, net_name)
+        return _write_snapshot_inner(
+            fmt, prefix, it, blobs, leaves, net_name, extra_state
+        )
 
 
 def _write_snapshot_inner(
-    fmt: str, prefix: str, it: int, blobs, leaves, net_name: str
+    fmt: str, prefix: str, it: int, blobs, leaves, net_name: str,
+    extra_state=None,
 ) -> Tuple[str, str]:
     os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
     if fmt == "HDF5":
@@ -254,10 +378,15 @@ def _write_snapshot_inner(
                 )
 
         _atomic(_savez, state_path)
+    paths = (model_path, state_path)
+    if extra_state:
+        jpath = jobstate_path_for(state_path)
+        _dump_job_state(jpath, extra_state)
+        paths = paths + (jpath,)
     # manifest publishes LAST: a kill between the data files and here
     # leaves a manifest-less (pre-format) snapshot, never a manifest
     # that vouches for half-written data
-    _write_manifest(it, fmt, (model_path, state_path))
+    _write_manifest(it, fmt, paths)
     tm = obs.training_metrics()
     if tm is not None:
         tm.snapshots.inc()
@@ -280,13 +409,17 @@ def _host_snapshot_args(solver, state, fmt: str):
 
 
 def snapshot(
-    solver, state, prefix: str, fmt: str = None
+    solver, state, prefix: str, fmt: str = None, extra_state=None
 ) -> Tuple[str, str]:
     """Write model + solver state; returns (model_path, state_path).
-    ``fmt`` overrides ``solver.param.snapshot_format``."""
+    ``fmt`` overrides ``solver.param.snapshot_format``.
+    ``extra_state`` (a nested dict of numpy arrays / JSON-ables)
+    publishes as the ``.jobstate.npz`` companion under the same CRC
+    manifest — the full-job-state snapshot (``load_job_state``)."""
     fmt, it, blobs, leaves = _host_snapshot_args(solver, state, fmt)
     return _write_snapshot(
-        fmt, prefix, it, blobs, leaves, solver.net.name or "net"
+        fmt, prefix, it, blobs, leaves, solver.net.name or "net",
+        extra_state,
     )
 
 
@@ -300,15 +433,39 @@ class AsyncCheckpointer:
     since updates are functional), then serializes and writes on a
     worker thread.  Files publish atomically, one snapshot is in flight
     at a time (a new ``save`` waits for the previous write), and worker
-    errors re-raise on the next ``save()``/``wait()``."""
+    errors re-raise on the next ``save()``/``wait()``.
 
-    def __init__(self) -> None:
+    Preemption contract: the worker is a daemon thread, so WITHOUT a
+    drain an interpreter exit (or a SIGTERM the driver acts on before
+    calling ``wait()``) could abandon the in-flight write — the round's
+    snapshot silently skipped, a ``*.tmp-<pid>`` left behind, while
+    ``_atomic`` guarantees nothing half-written ever PUBLISHES.  The
+    checkpointer therefore registers a bounded drain on BOTH exits: the
+    ``utils/signals.py`` SIGTERM hook registry (the orchestrator's
+    preemption notice) and ``atexit`` (which runs before daemon threads
+    are killed).  A write still wedged past ``drain_timeout_s`` is
+    abandoned cleanly — the previous snapshot stays the newest valid
+    restore point (regression-tested with a real SIGKILL mid-write)."""
+
+    def __init__(self, drain_timeout_s: float = 30.0) -> None:
+        import atexit
+
+        from sparknet_tpu.utils import signals as _signals
+
         self._thread = None
         self._exc: Optional[BaseException] = None
         self._last_paths: Optional[Tuple[str, str]] = None
+        self.drain_timeout_s = float(drain_timeout_s)
+        _signals.add_sigterm_hook(self._drain)
+        atexit.register(self._drain)
+        self._detach = lambda: (
+            _signals.remove_sigterm_hook(self._drain),
+            atexit.unregister(self._drain),
+        )
 
     def save(
-        self, solver, state, prefix: str, fmt: str = None
+        self, solver, state, prefix: str, fmt: str = None,
+        extra_state=None,
     ) -> None:
         import threading
 
@@ -319,7 +476,7 @@ class AsyncCheckpointer:
         def work():
             try:
                 self._last_paths = _write_snapshot(
-                    fmt, prefix, it, blobs, leaves, net_name
+                    fmt, prefix, it, blobs, leaves, net_name, extra_state
                 )
             except BaseException as e:  # noqa: BLE001 — re-raised on wait
                 self._exc = e
@@ -340,6 +497,34 @@ class AsyncCheckpointer:
             exc, self._exc = self._exc, None
             raise exc
         return self._last_paths
+
+    @property
+    def last_paths(self) -> Optional[Tuple[str, str]]:
+        """Paths of the newest PUBLISHED snapshot (None until the
+        first write completes) — journaling drivers commit the
+        previous async boundary once its publish is confirmed."""
+        return self._last_paths
+
+    def _drain(self) -> None:
+        """Bounded flush of the in-flight write (SIGTERM hook + atexit
+        — both may fire in teardown contexts, so this never raises:
+        errors surface on the next explicit ``wait()``, a wedged write
+        is abandoned with the previous snapshot intact)."""
+        t = self._thread
+        if t is None:
+            return
+        try:
+            t.join(timeout=self.drain_timeout_s)
+            if not t.is_alive():
+                self._thread = None
+        except Exception:  # noqa: BLE001 — signal/teardown context
+            pass
+
+    def close(self) -> None:
+        """Flush and detach the exit hooks (idempotent)."""
+        self._drain()
+        detach, self._detach = self._detach, lambda: None
+        detach()
 
 
 def _load_model_blobs(model_path: str):
@@ -444,6 +629,7 @@ def _quarantine(state_path: str) -> List[str]:
         state_path,
         base + ".caffemodel",
         base + ".caffemodel.h5",
+        base + _JOBSTATE_SUFFIX,
         mpath,
     ):
         if os.path.exists(p):
@@ -471,30 +657,42 @@ def restore_newest_valid(
     the next-older one.  Returns ``(state, state_path)``; raises
     ``FileNotFoundError`` when no snapshots exist at all and
     ``SnapshotCorrupt`` when every candidate is bad."""
-    import zipfile
-
     candidates = find_snapshots(prefix)
     if not candidates:
         raise FileNotFoundError(f"no {prefix}_iter_*.solverstate* snapshots")
+    return _restore_first_valid(
+        solver, list(reversed(candidates)), seed, quarantine,
+        label="restore_newest_valid", prefix=prefix,
+    )
+
+
+def _restore_first_valid(
+    solver, ordered, seed: int, quarantine: bool, label: str, prefix: str
+):
+    """Walk ``ordered`` candidate state paths (preferred first) and
+    restore the first that verifies — the one fallback/quarantine loop
+    behind BOTH the plain and the journal-guided resume.  Quarantines
+    ONLY evidence of file corruption: a failed manifest check, or (for
+    manifest-less legacy snapshots) a truncated/garbage container.
+    Anything else — solver mismatch, transient I/O — is a
+    caller/environment problem: renaming healthy snapshots for it
+    would destroy the very restore points this function protects."""
+    import zipfile
+
     failures = []
-    for state_path in reversed(candidates):
+    for state_path in ordered:
         try:
             return restore(solver, state_path, seed=seed), state_path
         except (ImportError, ModuleNotFoundError):
             raise  # missing h5py etc: environment problem, not corruption
         except Exception as e:  # noqa: BLE001 — classified below
             failures.append(f"{state_path}: {e}")
-            # Quarantine ONLY evidence of file corruption: a failed
-            # manifest check, or (for manifest-less legacy snapshots) a
-            # truncated/garbage container.  Anything else — solver
-            # mismatch, transient I/O — is a caller/environment problem:
-            # renaming healthy snapshots for it would destroy the very
-            # restore points this function exists to protect.
             is_corrupt = isinstance(
                 e, (SnapshotCorrupt, zipfile.BadZipFile, EOFError)
             )
             _log.warning(
-                "restore_newest_valid: skipping %s (%s)%s",
+                "%s: skipping %s (%s)%s",
+                label,
                 state_path,
                 e,
                 "; quarantining" if (quarantine and is_corrupt)
@@ -503,9 +701,88 @@ def restore_newest_valid(
             if quarantine and is_corrupt:
                 _quarantine(state_path)
     raise SnapshotCorrupt(
-        "no valid snapshot under prefix %r; all %d candidates failed:\n%s"
-        % (prefix, len(candidates), "\n".join(failures))
+        "%s: no valid snapshot under prefix %r; all %d candidates "
+        "failed:\n%s"
+        % (label, prefix, len(ordered), "\n".join(failures))
     )
+
+
+def _snapshot_iter(state_path: str) -> int:
+    return int(state_path.split("_iter_")[-1].split(".")[0])
+
+
+def restore_newest_valid_journaled(
+    solver,
+    prefix: str,
+    journal,
+    seed: int = 0,
+    quarantine: bool = True,
+):
+    """Journal-guided resume: reconcile the run ledger
+    (``io/journal.RunJournal``) against the snapshot set and rewind to
+    the last COMMITTED round boundary.
+
+    Rules (the exactly-once contract):
+
+    - the ledger's newest committed snapshot ref is the restore target;
+      if it fails verification it is quarantined and the scan falls
+      back to the next-older candidate,
+    - a snapshot NEWER than the committed boundary (published for a
+      round whose commit never landed — a kill between the snapshot
+      publish and the journal append) is IGNORED: its round is
+      uncommitted and must be re-executed, not skipped,
+    - a ledger with no commits means round 0 never completed:
+      ``FileNotFoundError`` (the caller starts fresh at round 0).
+      That is the ONLY FileNotFoundError case — commits whose
+      snapshots have vanished raise ``SnapshotCorrupt`` instead:
+      training fresh weights while resuming at a committed round
+      would silently skip every round the ledger vouches for.
+
+    Returns ``(state, state_path, job_state, info)`` where
+    ``job_state`` is the restored snapshot's jobstate companion (None
+    for plain snapshots) and ``info`` is ``journal.reconcile()``.
+    """
+    info = journal.reconcile()
+    if info["last_committed_round"] is None:
+        raise FileNotFoundError(
+            f"journal {journal.path}: no committed round — nothing to "
+            "resume (start fresh at round 0)"
+        )
+    commit_iter = info["commit_iter"]
+    candidates = find_snapshots(prefix)
+    if commit_iter is not None:
+        eligible = [
+            p for p in candidates if _snapshot_iter(p) <= commit_iter
+        ]
+        skipped = len(candidates) - len(eligible)
+        if skipped:
+            _log.warning(
+                "journaled resume: ignoring %d snapshot(s) beyond the "
+                "committed boundary (iter %d) — their rounds never "
+                "committed and will re-execute",
+                skipped, commit_iter,
+            )
+        candidates = eligible
+    if not candidates:
+        # the journal vouches for committed work whose durable state is
+        # GONE — a fresh init here would silently skip those rounds, so
+        # this is a corruption-class failure, never a quiet fresh start
+        raise SnapshotCorrupt(
+            f"journaled resume: no snapshot at or before the committed "
+            f"boundary under {prefix!r} (journal says round "
+            f"{info['last_committed_round']} committed)"
+        )
+    # prefer the exact committed ref, then fall back newest-first
+    ref = info["snapshot"]
+    ordered = sorted(candidates, key=_snapshot_iter)
+    if ref is not None:
+        exact = [p for p in ordered if os.path.basename(p) == ref]
+        ordered = [p for p in ordered if os.path.basename(p) != ref] + exact
+    state, state_path = _restore_first_valid(
+        solver, list(reversed(ordered)), seed, quarantine,
+        label="journaled resume", prefix=prefix,
+    )
+    return state, state_path, load_job_state(state_path), info
 
 
 def load_weights_into_state(solver, state, model_path: str):

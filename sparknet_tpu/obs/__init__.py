@@ -355,6 +355,25 @@ class TrainingMetrics:
             "pushes that exhausted their retry budget (collector "
             "unreachable; events stayed buffered)",
         )
+        # run-journal / crash-recovery series (io/journal.py +
+        # journaled resume paths) — zero until a run arms --journal
+        self.journal_records = registry.counter(
+            "sparknet_journal_records_total",
+            "run-journal records appended, by kind (intent = round "
+            "write-ahead, commit = durable round boundary)",
+            labels=("kind",),
+        )
+        self.journal_truncated = registry.counter(
+            "sparknet_journal_truncated_total",
+            "torn journal tails truncated on open (a kill landed "
+            "mid-append; the partial frame failed its CRC)",
+        )
+        self.recover_replayed = registry.counter(
+            "sparknet_recover_replayed_rounds_total",
+            "rounds re-executed after a journal-guided resume (the "
+            "in-flight round whose commit never landed; at most one "
+            "per recovery when every boundary snapshots)",
+        )
 
 
 _lock = threading.Lock()

@@ -107,6 +107,9 @@ class HostState:
         self.pushes = 0
         self.restarts = 0
         self.lost_pushes = 0
+        # terminal heartbeat seen (shipper stop() marks its last push
+        # final): a cleanly-finished host, exempt from dead-marking
+        self.finished = False
 
     def lost_events(self) -> int:
         """Events the shipper enqueued that neither arrived here nor
@@ -144,7 +147,8 @@ class FleetCollector:
             "sparknet_fleet_hosts",
             "hosts per liveness state (live = heartbeating and keeping "
             "up, late = round heartbeat lags the fleet median past the "
-            "threshold, dead = missed the push deadline)",
+            "threshold, dead = missed the push deadline, finished = "
+            "terminal heartbeat seen: a clean exit, never dead)",
             labels=("state",),
         )
         self.m_round = r.gauge(
@@ -268,6 +272,14 @@ class FleetCollector:
                 st.last_seq = None
                 self.m_resets.labels(host).inc()
             st.boot_id = boot
+            if payload.get("final"):
+                st.finished = True
+            elif st.finished:
+                # the same host pushing again after its terminal
+                # heartbeat (a restart under the same id with the same
+                # boot_id is impossible; same id + new boot_id resets
+                # above) — treat it as live again
+                st.finished = False
             seq = payload.get("seq")
             if isinstance(seq, int) and st.last_seq is not None:
                 if seq > st.last_seq + 1:
@@ -342,7 +354,10 @@ class FleetCollector:
         states: Dict[str, str] = {}
         live_rounds: List[int] = []
         for h, st in self._hosts.items():
-            if now_mono - st.last_seen_mono > self.dead_after_s:
+            if st.finished:
+                # terminal heartbeat seen: a clean exit, never "dead"
+                states[h] = "finished"
+            elif now_mono - st.last_seen_mono > self.dead_after_s:
                 states[h] = "dead"
             else:
                 states[h] = "live"
@@ -369,7 +384,14 @@ class FleetCollector:
             rounds = []
             fleet_counters: Dict[str, float] = {}
             for h, st in sorted(self._hosts.items()):
-                if states[h] != "dead" and st.round is not None:
+                # skew/median cover hosts still PARTICIPATING: a dead
+                # host's stale round is a detection anchor, a finished
+                # host's is history — neither may drag the aggregates
+                # (a host finishing at round N would otherwise grow
+                # round_skew forever as the rest train on)
+                if states[h] not in ("dead", "finished") and (
+                    st.round is not None
+                ):
                     rounds.append(st.round)
                 for name, v in st.counters.items():
                     fleet_counters[name] = fleet_counters.get(name, 0.0) + v
@@ -395,7 +417,7 @@ class FleetCollector:
                     "gauges": dict(st.gauges),
                 }
             skew = (max(rounds) - min(rounds)) if rounds else 0
-            by_state = {"live": 0, "late": 0, "dead": 0}
+            by_state = {"live": 0, "late": 0, "dead": 0, "finished": 0}
             for s in states.values():
                 by_state[s] += 1
         for s, n in by_state.items():
@@ -408,6 +430,7 @@ class FleetCollector:
                 "hosts_live": by_state["live"],
                 "hosts_late": by_state["late"],
                 "hosts_dead": by_state["dead"],
+                "hosts_finished": by_state["finished"],
                 "round_median": (
                     sorted(rounds)[len(rounds) // 2] if rounds else None
                 ),

@@ -261,6 +261,39 @@ class HealthSentry:
         }
 
     # ------------------------------------------------------------------
+    # full job state (crash consistency): the sentry's carried scalars.
+    # A resume that drops these silently restarts the loss-EMA warmup
+    # and forgets an active cooldown — the z-score lens changes, so a
+    # spike right after restart reads differently than it would have in
+    # the uninterrupted run.  Journaled beside params by the recover
+    # loop (io/checkpoint extra_state; runtime/recover.py).
+    def export_state(self) -> Dict:
+        return {
+            "ema": self._ema,
+            "emvar": self._emvar,
+            "seen": self._seen,
+            "cooldown": self._cooldown,
+            "last_anomaly_round": self.last_anomaly_round,
+            "last_round": self.last_round,
+            "rounds_observed": self.rounds_observed,
+            "anomalies": self.anomalies,
+            "rollbacks": self.rollbacks,
+        }
+
+    def load_state(self, d: Dict) -> None:
+        self._ema = None if d.get("ema") is None else float(d["ema"])
+        self._emvar = float(d.get("emvar", 0.0))
+        self._seen = int(d.get("seen", 0))
+        self._cooldown = int(d.get("cooldown", 0))
+        lar = d.get("last_anomaly_round")
+        self.last_anomaly_round = None if lar is None else int(lar)
+        lr = d.get("last_round")
+        self.last_round = None if lr is None else int(lr)
+        self.rounds_observed = int(d.get("rounds_observed", 0))
+        self.anomalies = int(d.get("anomalies", 0))
+        self.rollbacks = int(d.get("rollbacks", 0))
+
+    # ------------------------------------------------------------------
     # z-score machinery (host floats only)
     def _spike(self, z: float) -> bool:
         """Strictly ABOVE the threshold flags — a loss sitting exactly

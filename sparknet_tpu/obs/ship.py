@@ -117,6 +117,7 @@ class Shipper:
         self.resets_seen: List[str] = []
         self._stop_evt = threading.Event()
         self._backoff_s = 0.0
+        self._drain_deadline: Optional[float] = None
         self._thread = threading.Thread(
             target=self._loop, name="obs-shipper", daemon=True
         )
@@ -156,9 +157,16 @@ class Shipper:
         return self
 
     def stop(self, flush_timeout_s: float = 5.0) -> None:
-        """Signal the ship thread, wait for its final flush attempt."""
+        """Signal the ship thread and wait for its final DRAIN: the
+        exit path flushes repeatedly (bounded by ``flush_timeout_s``)
+        until the buffer is empty — a backlog larger than one batch is
+        not silently abandoned on a clean exit — and the last payload
+        carries ``final: true``, the terminal heartbeat that tells the
+        collector this host FINISHED (it is never later classified
+        ``dead`` for going quiet)."""
+        self._drain_deadline = time.monotonic() + float(flush_timeout_s)
         self._stop_evt.set()
-        self._thread.join(timeout=flush_timeout_s)
+        self._thread.join(timeout=flush_timeout_s + 1.0)
 
     @property
     def alive(self) -> bool:
@@ -181,7 +189,35 @@ class Shipper:
                 self._backoff_s = min(
                     _BACKOFF_CAP_S, max(self.interval_s, self._backoff_s * 2)
                 )
-        self._flush()  # final tail flush (best effort, budgeted)
+        self._drain_tail()  # bounded final drain + terminal heartbeat
+
+    def _drain_tail(self) -> None:
+        """Clean-exit drain: flush until the buffer is empty (each
+        push moves at most ``max_batch`` events — one final flush used
+        to strand a larger backlog) or the stop() deadline passes.
+        The LAST push is marked ``final`` so the collector records the
+        host as finished instead of letting the dead-after deadline
+        condemn a cleanly-exited process."""
+        deadline = self._drain_deadline or (time.monotonic() + 5.0)
+        while True:
+            # the LAST push of the drain is always the final one: when
+            # the remaining backlog fits one batch, or when the
+            # deadline forces an early exit (a timed-out drain still
+            # delivers the terminal heartbeat; only a DOWN collector —
+            # a failed push — exits without one, and a down collector
+            # could not have received it anyway)
+            final = (
+                self.buffered() <= self.max_batch
+                or time.monotonic() >= deadline
+            )
+            ok = self._flush(final=final)
+            if not ok:
+                # collector down: _flush already spent its retry
+                # budget — a clean exit must not stall on an outage
+                # (whatever remains stays accounted in dropped/lost)
+                return
+            if final:
+                return  # terminal heartbeat delivered
 
     def _snapshot(self):
         reg = self._registry
@@ -194,12 +230,13 @@ class Shipper:
             return {"counters": {}, "gauges": {}}
         return reg.snapshot()
 
-    def _flush(self) -> bool:
+    def _flush(self, final: bool = False) -> bool:
         """Compose one push from the buffered events + the counter
         delta since the last SUCCESSFUL push; returns success.  On
         failure everything stays buffered (events re-queued, snapshot
         not advanced) so nothing is lost while the collector is down —
-        only a buffer overflow drops (and counts) events."""
+        only a buffer overflow drops (and counts) events.  ``final``
+        marks the payload as this host's terminal heartbeat."""
         from sparknet_tpu.obs.metrics import counter_deltas
         from sparknet_tpu.utils import retry as _retry
 
@@ -245,6 +282,7 @@ class Shipper:
             "events_total": events_total,
             "dropped_total": dropped_total,
             "resets": resets,
+            "final": bool(final),
         }
         body = json.dumps(payload, default=str).encode("utf-8")
         policy = _retry.RetryPolicy(

@@ -219,6 +219,9 @@ class CommPlane:
         self._payload_bytes_per_round = 0  # modeled, set at _setup
         self._pending = None  # in-flight overlapped round
         self._pending_err = None  # dispatched quant-error readout
+        # journaled residuals restored before the first round (consumed
+        # by _setup in place of the zero init — the resume path)
+        self._resid_restore: Optional[list] = None
 
         audit = self.audit
         mask_nf = self.mask_nonfinite
@@ -431,7 +434,20 @@ class CommPlane:
         slices.append(slice(start, len(leaves)))
         self._chunk_slices = [s for s in slices if s.stop > s.start]
         self._payload_bytes_per_round = _RING_FACTOR * total
-        self._resid = [jnp.zeros_like(x) for x in leaves]
+        restore, self._resid_restore = self._resid_restore, None
+        if restore is not None:
+            # journaled EF residuals restored before the first round
+            if len(restore) != len(leaves) or any(
+                tuple(r.shape) != tuple(x.shape)
+                for r, x in zip(restore, leaves)
+            ):
+                raise ValueError(
+                    "restored jobstate residuals do not match this "
+                    "plane's comm leaves (model/worker-count drift?)"
+                )
+            self._resid = [jnp.asarray(r) for r in restore]
+        else:
+            self._resid = [jnp.zeros_like(x) for x in leaves]
 
     def _comm_leaves(self, state) -> list:
         leaves = list(jax.tree_util.tree_leaves(state.params))
@@ -468,8 +484,64 @@ class CommPlane:
         self._pending = None
         self._pending_err = None
         self._anchor = None
+        self._resid_restore = None  # a stale pre-broadcast restore dies too
         if self._resid is not None:
             self._resid = [jnp.zeros_like(r) for r in self._resid]
+
+    def export_state(self) -> Optional[dict]:
+        """Host copy of the carried error-feedback residuals — the
+        comm-plane half of a full-job-state snapshot (``io/checkpoint``
+        ``extra_state``).  A resumed run that does NOT restore this
+        silently resets the EF bias correction and diverges from the
+        uninterrupted trajectory (measured: ``bench.py --mode=recover``
+        ``--no_journal`` leg).  Call at a round boundary with no
+        in-flight overlapped collective (``finalize()`` first)."""
+        if self._resid is None:
+            return None
+        if self._pending is not None:
+            raise RuntimeError(
+                "export_state with an overlapped collective in flight — "
+                "finalize() the round first"
+            )
+        return {
+            "compress": self.compress,
+            "resid": {
+                str(i): np.asarray(jax.device_get(r))
+                for i, r in enumerate(self._resid)
+            },
+        }
+
+    def restore_state(self, exported: dict) -> None:
+        """Load residuals exported by ``export_state``.  Call AFTER the
+        restore path's ``reset()`` (``broadcast_state`` triggers it) —
+        the restore order is: place the snapshot params, then put the
+        journaled residuals back.  A compress-mode or shape mismatch
+        fails loudly: silently training on wrong residuals is exactly
+        the bug this state exists to prevent."""
+        if exported.get("compress") != self.compress:
+            raise ValueError(
+                "jobstate residuals were recorded under compress=%r, "
+                "this plane runs %r"
+                % (exported.get("compress"), self.compress)
+            )
+        resid = exported["resid"]
+        leaves = [resid[str(i)] for i in range(len(resid))]
+        if self._resid is not None:
+            if len(leaves) != len(self._resid):
+                raise ValueError(
+                    f"jobstate has {len(leaves)} residual leaves, plane "
+                    f"carries {len(self._resid)}"
+                )
+            for got, want in zip(leaves, self._resid):
+                if tuple(got.shape) != tuple(want.shape):
+                    raise ValueError(
+                        f"residual shape {got.shape} != {want.shape}"
+                    )
+            self._resid = [jnp.asarray(l) for l in leaves]
+        else:
+            # first round hasn't run: _setup consumes these instead of
+            # zeros (shape-checked there against the real comm leaves)
+            self._resid_restore = [np.asarray(l) for l in leaves]
 
     def _join_pending(self) -> dict:
         """Wait for the in-flight chunk collectives; re-raise comm-

@@ -568,6 +568,36 @@ class ParameterAveragingTrainer:
             self.axis,
         )
 
+    # --- full job state (crash consistency, io/checkpoint extra_state)
+    def export_comm_state(self):
+        """The comm plane's carried error-feedback residuals as a
+        host-side jobstate fragment, or None on the classic fused
+        round (no carried state).  Snapshot this beside params so a
+        resumed run continues the EF-SGD trajectory bit-identically
+        (``runtime/recover.py``)."""
+        if self._comm is None:
+            return None
+        return self._comm.export_state()
+
+    def restore_comm_state(self, exported) -> None:
+        """Load residuals exported by ``export_comm_state`` — call
+        AFTER ``broadcast_state`` (which resets the plane) so the
+        journaled residuals land on the freshly placed params."""
+        if exported is None:
+            return
+        if self._comm is None:
+            raise ValueError(
+                "jobstate carries comm residuals but this trainer runs "
+                "the classic fused round (compress/overlap off)"
+            )
+        self._comm.restore_state(exported)
+
+    def reset_comm_state(self) -> None:
+        """Drop carried comm state (fresh-run entry for a reused
+        trainer: in-process chaos/recover harnesses)."""
+        if self._comm is not None:
+            self._comm.reset()
+
     def _place_live(self, live_mask) -> jax.Array:
         """Place a host (num_workers,) 0/1 mask over the dp axis.
         Cached per distinct mask value — the loops pass the same mask

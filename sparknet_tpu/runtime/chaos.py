@@ -184,6 +184,18 @@ class FaultPlan:
     slice_relaunch_delta: int = 1  # note_join at END of round R+delta
     membership_slices: int = 2
     cross_slice_every: int = 2
+    # driver_kill: at the END of this round, one kill-point of the
+    # crash-consistency sweep runs as a bounded sub-scenario
+    # (runtime/recover.py): a journaled mini-driver (int8 EF residuals,
+    # sentry, membership epoch all carried as job state) is crashed
+    # MID-JOURNAL-APPEND — half a commit frame lands durably — and
+    # resumed.  Survived = the torn tail was truncated on open, the
+    # resume rewound to the last committed boundary, re-executed at
+    # most ONE round, and the final state digest is BIT-IDENTICAL to
+    # an uninterrupted control.  (The in-process stand-in for the
+    # SIGKILL sweep; the real kill-anywhere proof is ``bench.py
+    # --mode=recover`` / RECOVER_r17.)
+    driver_kill_round: Optional[int] = 5
 
     @classmethod
     def default(cls) -> "FaultPlan":
@@ -206,6 +218,7 @@ class FaultPlan:
             replica_death_round=None,
             publish_corrupt_round=None,
             slice_preempt_round=None,
+            driver_kill_round=None,
         )
 
 
@@ -522,6 +535,282 @@ class _ServeFaults:
             self._router.close()
             self._router = None
             self._pool = None
+
+
+def _driver_kill_scenario(plan: FaultPlan, counters: Dict, note, workdir):
+    """The driver_kill fault: crash a journaled mini-driver mid-commit
+    and prove bit-identical journal-guided recovery (in-process — the
+    kill hook raises instead of SIGKILLing so the chaos harness
+    survives; ``run_kill_sweep`` is the real-SIGKILL version)."""
+    from sparknet_tpu.runtime import recover as recover_mod
+
+    base = os.path.join(workdir, "driver_kill")
+    ctx = recover_mod.RecoverContext(
+        base, workers=2, tau=1, batch=8, seed=plan.seed
+    )
+    kill_rounds = 3
+    kill_at = ("journal_mid_append", 1)
+
+    def boom():
+        raise recover_mod.SimulatedKill("driver_kill")
+
+    control = recover_mod.run_driver(
+        ctx, kill_rounds, run_dir=os.path.join(base, "control")
+    )
+    counters["driver_kill_injected"] = 1
+    _obs.fault(
+        "driver_kill", kill_at="%s:%d" % kill_at, rounds=kill_rounds
+    )
+    note(
+        "driver_kill: journaled driver crashed mid-commit-append at "
+        "round %d (half a frame durable on disk)" % kill_at[1]
+    )
+    fault_dir = os.path.join(base, "fault")
+    crashed = False
+    try:
+        recover_mod.run_driver(
+            ctx, kill_rounds, kill_at=kill_at, kill=boom,
+            run_dir=fault_dir,
+        )
+    except recover_mod.SimulatedKill:
+        crashed = True
+    resumed = recover_mod.run_driver(
+        ctx, kill_rounds, resume=True, run_dir=fault_dir
+    )
+    # the crashed run executed rounds 0..kill_at[1]; anything the
+    # resume re-executes in that range is a replay
+    replayed = len(
+        [r for r in resumed["rounds_executed"] if r <= kill_at[1]]
+    )
+    bit_identical = resumed["final_digest"] == control["final_digest"]
+    survived = bool(
+        crashed
+        and resumed["journal_truncated_bytes"] > 0  # tail really torn
+        and replayed <= 1
+        and bit_identical
+    )
+    if survived:
+        counters["driver_kill_survived"] = 1
+        note(
+            "driver_kill survived: torn tail truncated (%d bytes), "
+            "resumed at round %d replaying %d round(s), final state "
+            "digest BIT-IDENTICAL to the uninterrupted control"
+            % (
+                resumed["journal_truncated_bytes"],
+                resumed["start_round"], replayed,
+            )
+        )
+        _obs.instant(
+            "recovered", kind="driver_kill", replayed=replayed,
+        )
+    return {
+        "kill_at": "%s:%d" % kill_at,
+        "crashed": crashed,
+        "journal_truncated_bytes": resumed["journal_truncated_bytes"],
+        "resumed_start_round": resumed["start_round"],
+        "replayed_rounds": replayed,
+        "bit_identical": bit_identical,
+        "control_digest": control["final_digest"],
+        "resumed_digest": resumed["final_digest"],
+        "recovery_latency_s": resumed["restore_s"],
+    }
+
+
+def run_kill_sweep(
+    workdir: Optional[str] = None,
+    rounds: int = 4,
+    kill_round: int = 2,
+    workers: int = 2,
+    tau: int = 2,
+    batch: int = 8,
+    seed: int = 7,
+    kill_points: Optional[Tuple[str, ...]] = None,
+    timeout_s: float = 900.0,
+    echo=None,
+) -> Dict:
+    """The kill-anywhere chaos sweep (``bench.py --mode=recover``):
+    for every phase boundary of the journaled driver loop
+    (``runtime/recover.py``), a REAL ``SIGKILL`` is delivered at that
+    exact point of a subprocess run, the process is relaunched with
+    ``--resume``, and the resumed trajectory is judged against an
+    uninterrupted control:
+
+    - ``bit_identical``: the full-job-state digest (params, history,
+      iter, EF residuals, sentry EMA) equals the control's,
+    - ``replayed_rounds``: rounds the resume re-executed that the
+      killed run had already executed — must be 0 or 1 (exactly-once
+      at snapshot granularity; the loop snapshots every boundary),
+    - latency: the resume's restore/reconcile time.
+
+    Plus the two controls that keep the proof honest: a ``--no_journal``
+    kill+resume that must DIVERGE (the journaled state really is
+    load-bearing), and a journal-off uninterrupted run whose digest
+    must EQUAL the control's (the ledger itself never perturbs the
+    math) — also the overhead A/B baseline."""
+    import json as _json
+    import subprocess
+    import sys as _sys
+
+    from sparknet_tpu.runtime import recover as recover_mod
+
+    kill_points = tuple(kill_points or recover_mod.KILL_POINTS)
+    workdir = workdir or tempfile.mkdtemp(prefix="recover_sweep_")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    base_args = [
+        "--rounds", str(rounds), "--workers", str(workers),
+        "--tau", str(tau), "--batch", str(batch), "--seed", str(seed),
+    ]
+
+    def say(msg: str) -> None:
+        if echo is not None:
+            echo("recover: " + msg)
+
+    def child(wd: str, *extra: str):
+        cmd = (
+            [_sys.executable, "-m", "sparknet_tpu.runtime.recover",
+             "--workdir", wd]
+            + base_args + list(extra)
+        )
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, env=env,
+            timeout=timeout_s,
+        )
+        rec = None
+        if proc.returncode == 0:
+            for line in reversed(proc.stdout.strip().splitlines()):
+                line = line.strip()
+                if line.startswith("{"):
+                    rec = _json.loads(line)
+                    break
+            if rec is None:
+                raise RuntimeError(
+                    "recover child printed no JSON:\n%s\n%s"
+                    % (proc.stdout[-2000:], proc.stderr[-2000:])
+                )
+        return proc.returncode, rec, time.perf_counter() - t0
+
+    say("control run (journal on, no kill)")
+    rc, control, _ = child(os.path.join(workdir, "control"))
+    if rc != 0:
+        raise RuntimeError(f"recover control run failed (rc {rc})")
+    say("journal-off control (overhead baseline + bit-neutrality)")
+    rc, nojournal_full, _ = child(
+        os.path.join(workdir, "nojournal_full"), "--no_journal"
+    )
+    if rc != 0:
+        raise RuntimeError(f"recover no-journal run failed (rc {rc})")
+
+    results = []
+    for kp in kill_points:
+        wd = os.path.join(workdir, "kill_" + kp)
+        say(f"SIGKILL at {kp}:{kill_round} -> resume")
+        rc1, _, _ = child(wd, "--kill_at", f"{kp}:{kill_round}")
+        killed = rc1 != 0  # SIGKILL: -9 from subprocess.run
+        rc2, rec, _ = child(wd, "--resume")
+        # rounds the killed run had already EXECUTED: the kill fires
+        # before trainer.round for assemble/h2d, after it otherwise
+        executed_before = kill_round + (
+            0 if kp in ("assemble", "h2d") else 1
+        )
+        row = {
+            "kill_at": f"{kp}:{kill_round}",
+            "killed": killed,
+            "resumed_rc": rc2,
+            "bit_identical": bool(
+                rec and rec["final_digest"] == control["final_digest"]
+            ),
+            "replayed_rounds": (
+                len([
+                    r for r in rec["rounds_executed"]
+                    if r < executed_before
+                ])
+                if rec else None
+            ),
+            "recovery_latency_s": rec["restore_s"] if rec else None,
+            "resumed_from": rec["resumed_from"] if rec else None,
+            "start_round": rec["start_round"] if rec else None,
+            "journal_truncated_bytes": (
+                rec["journal_truncated_bytes"] if rec else None
+            ),
+        }
+        row["survived"] = bool(
+            row["killed"]
+            and rc2 == 0
+            and row["bit_identical"]
+            and row["replayed_rounds"] is not None
+            and row["replayed_rounds"] <= 1
+        )
+        say(
+            "%s: %s (replayed %s, latency %ss)"
+            % (
+                row["kill_at"],
+                "SURVIVED bit-identical" if row["survived"] else
+                "FAILED " + _json.dumps(row),
+                row["replayed_rounds"], row["recovery_latency_s"],
+            )
+        )
+        results.append(row)
+
+    # the non-vacuous control: the SAME kill without the journal must
+    # visibly diverge (plain newest-snapshot resume resets the EF
+    # residuals and per-worker momentum)
+    say(f"no-journal divergence control: SIGKILL at average:{kill_round}")
+    wd = os.path.join(workdir, "nojournal_kill")
+    rc1, _, _ = child(wd, "--no_journal", "--kill_at",
+                      f"average:{kill_round}")
+    rc2, njrec, _ = child(wd, "--no_journal", "--resume")
+    no_journal_diverged = bool(
+        rc1 != 0 and rc2 == 0 and njrec
+        and njrec["final_digest"] != control["final_digest"]
+    )
+    say(
+        "no-journal resume %s the control"
+        % ("DIVERGED from" if no_journal_diverged else
+           "unexpectedly matched")
+    )
+
+    def p50(xs):
+        s = sorted(xs)
+        return s[len(s) // 2] if s else None
+
+    # steady rounds only: round 0 carries the jit compile
+    j_ms = p50(control["round_ms"][1:])
+    nj_ms = p50(nojournal_full["round_ms"][1:])
+    overhead_pct = (
+        100.0 * (j_ms - nj_ms) / nj_ms if j_ms and nj_ms else None
+    )
+    return {
+        "rounds": rounds,
+        "workers": workers,
+        "tau": tau,
+        "batch": batch,
+        "seed": seed,
+        "kill_round": kill_round,
+        "killpoints_total": len(results),
+        "killpoints_survived": sum(
+            1 for r in results if r["survived"]
+        ),
+        "killpoints": results,
+        "bit_identical_all": all(r["bit_identical"] for r in results),
+        "max_replayed_rounds": max(
+            (r["replayed_rounds"] for r in results
+             if r["replayed_rounds"] is not None),
+            default=None,
+        ),
+        "control_digest": control["final_digest"],
+        "no_journal_diverged": no_journal_diverged,
+        "no_journal_digest": njrec["final_digest"] if njrec else None,
+        "journal_bit_neutral": bool(
+            nojournal_full["final_digest"] == control["final_digest"]
+        ),
+        "journal_round_ms_p50": round(j_ms, 2) if j_ms else None,
+        "nojournal_round_ms_p50": round(nj_ms, 2) if nj_ms else None,
+        "journal_overhead_pct": (
+            round(overhead_pct, 2) if overhead_pct is not None else None
+        ),
+        "workdir": workdir,
+    }
 
 
 # ----------------------------------------------------------------------
@@ -1082,6 +1371,17 @@ def run_chaos(
                 r, solver,
                 lambda: first_worker(jax.device_get(state)),
             )
+        if (
+            plan.driver_kill_round is not None
+            and r == plan.driver_kill_round
+            and not counters.get("driver_kill_injected")
+        ):
+            # crash-consistency fault: a journaled driver killed
+            # mid-commit, recovered bit-identically (fires once; runs
+            # as a bounded sub-scenario like the serve faults)
+            counters["driver_kill_summary"] = _driver_kill_scenario(
+                plan, counters, note, workdir
+            )
         if membership_ctl is not None:
             if (
                 r == plan.slice_preempt_round
@@ -1312,6 +1612,9 @@ def run_chaos(
         "slice_preemption": (
             "slice_preempt_injected", "slice_preempt_survived",
         ),
+        "driver_kill": (
+            "driver_kill_injected", "driver_kill_survived",
+        ),
     }
     faults = {
         kind: {
@@ -1345,6 +1648,8 @@ def run_chaos(
         "collector_outage": outage.summary if outage is not None else None,
         "replica_death_round": plan.replica_death_round,
         "publish_corrupt_round": plan.publish_corrupt_round,
+        "driver_kill_round": plan.driver_kill_round,
+        "driver_kill": counters.get("driver_kill_summary"),
         "slice_preempt_round": plan.slice_preempt_round,
         "slice_preempt_slice": plan.slice_preempt_slice,
         "slice_leave_round": counters.get("slice_leave_round"),

@@ -443,6 +443,49 @@ class MembershipController:
             "transitions": len(self.transitions),
         }
 
+    # --- full job state (crash consistency, io/checkpoint extra_state)
+    def export_state(self) -> Dict:
+        """The roster scalars a restarted driver needs to continue the
+        SAME view history: epoch, round, per-worker states, and
+        leave-grace bookkeeping.  Queued-but-unapplied events are
+        deliberately NOT exported — an event that never reached a
+        round boundary is not yet part of the job's state (the source
+        re-delivers: SIGTERM re-fires, fleet views re-ingest)."""
+        with self._lock:
+            return {
+                "epoch": int(self._epoch),
+                "round": int(self._round),
+                "states": list(self._states),
+                "leaving_since": {
+                    str(w): int(r)
+                    for w, r in self._leaving_since.items()
+                },
+            }
+
+    def load_state(self, d: Dict) -> None:
+        """Restore a view exported by ``export_state`` — the resumed
+        epoch numbering continues where the crashed driver's stopped
+        (monotonic across the restart, so downstream consumers never
+        see the epoch clock rewind)."""
+        states = [str(s) for s in d["states"]]
+        if len(states) != self.num_workers:
+            raise ValueError(
+                f"jobstate roster has {len(states)} workers, spec has "
+                f"{self.num_workers}"
+            )
+        with self._lock:
+            self._epoch = int(d["epoch"])
+            self._round = int(d["round"])
+            self._states = states
+            self._leaving_since = {
+                int(w): int(r)
+                for w, r in (d.get("leaving_since") or {}).items()
+            }
+            self._view = MembershipView(
+                self._epoch, self._round, tuple(self._states), self.spec
+            )
+        self._publish_metrics()
+
     def epochs_monotonic(self) -> bool:
         """True iff the logged transition epochs strictly increase per
         bump (the chaos/bench verdict helper)."""

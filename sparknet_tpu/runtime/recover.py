@@ -1,0 +1,495 @@
+"""The journaled driver loop: crash-consistent training, provable.
+
+``run_driver`` is a small cifar10_quick parameter-averaging loop wired
+the way a crash-consistent production driver must be:
+
+- every round is bracketed by a **write-ahead intent** and a
+  **durable commit** in the run journal (``io/journal.RunJournal``),
+- every committed boundary snapshots the FULL job state: params +
+  history (the classic snapshot) plus the CommPlane error-feedback
+  residuals, the sentry EMA/cooldown, the membership view epoch and
+  the data cursor (``io/checkpoint.snapshot(extra_state=...)``),
+- resume reconciles ledger vs snapshots
+  (``checkpoint.restore_newest_valid_journaled``): rewind to the last
+  committed boundary, re-execute the one in-flight round, never
+  re-execute a committed one.
+
+The loop doubles as the kill-anywhere chaos child: ``--kill_at
+PHASE:ROUND`` SIGKILLs the process at a named phase boundary —
+
+    assemble            after the round's host batch is built
+    h2d                 after the dp-sharded device placement
+    execute             after the fused local-steps+average returns
+    average             after the sentry consumed the round's stats
+    snapshot_mid_write  mid-write of the solverstate file (the tmp is
+                        written, the publish rename never happens)
+    journal_mid_append  mid-append of the commit record (half a frame
+                        lands durably — the torn tail truncation case)
+
+— and ``runtime/chaos.run_kill_sweep`` drives the full sweep: each
+kill-point's resumed trajectory must be BIT-IDENTICAL to an
+uninterrupted control (the digest covers params, history, iter, EF
+residuals and sentry EMA), with at most one replayed round.  The
+``--no_journal`` leg proves the zero is not vacuous: resuming from the
+plain newest snapshot resets the EF residuals and measurably diverges.
+
+Subprocess entry::
+
+    python -m sparknet_tpu.runtime.recover --workdir DIR --rounds 4 \
+        [--kill_at execute:2] [--resume] [--no_journal]
+
+prints one JSON line (rounds executed, final state digest, per-round
+wall times, restore latency).  Importable pieces (``RecoverContext``,
+``run_driver`` with ``kill=<raise>``) power the in-process tier-1
+tests and the chaos harness's ``driver_kill`` fault.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import signal as _signal
+import sys
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+KILL_POINTS = (
+    "assemble",
+    "h2d",
+    "execute",
+    "average",
+    "snapshot_mid_write",
+    "journal_mid_append",
+)
+
+
+class SimulatedKill(BaseException):
+    """The in-process stand-in for SIGKILL (tests / chaos driver_kill):
+    raised by a kill hook, caught by the harness — deliberately a
+    BaseException so no library ``except Exception`` can absorb it."""
+
+
+def sigkill_self() -> None:
+    os.kill(os.getpid(), _signal.SIGKILL)
+
+
+def parse_kill_at(value: Optional[str]) -> Tuple[Optional[str], int]:
+    if not value:
+        return None, -1
+    phase, _, r = value.partition(":")
+    if phase not in KILL_POINTS:
+        raise ValueError(
+            f"kill_at phase {phase!r}: expected one of {KILL_POINTS}"
+        )
+    return phase, int(r or 0)
+
+
+class RecoverContext:
+    """Everything expensive, built once: data, solver (audit on),
+    mesh, trainer (int8 delta averaging so real EF-residual state is
+    carried).  Reusable across in-process control/crash/resume runs —
+    the jitted programs compile once."""
+
+    def __init__(
+        self,
+        workdir: str,
+        workers: int = 2,
+        tau: int = 2,
+        batch: int = 8,
+        seed: int = 7,
+        compress: str = "int8",
+    ):
+        import jax
+
+        from sparknet_tpu import config as cfg, models
+        from sparknet_tpu.data import CifarLoader
+        from sparknet_tpu.parallel import (
+            ParameterAveragingTrainer,
+            make_mesh,
+        )
+        from sparknet_tpu.solver import Solver
+
+        self.workdir = workdir
+        self.workers = workers
+        self.tau = tau
+        self.batch = batch
+        self.seed = seed
+        self.compress = compress
+        os.makedirs(workdir, exist_ok=True)
+        data_dir = os.path.join(workdir, "data")
+        if not os.path.isdir(data_dir):
+            CifarLoader.write_synthetic(
+                data_dir, num_train=256, num_test=32, seed=seed
+            )
+        self.xs, self.ys = CifarLoader(data_dir).minibatches(
+            batch, train=True
+        )
+        netp = cfg.replace_data_layers(
+            models.load_model("cifar10_quick"),
+            [(batch, 3, 32, 32), (batch,)],
+            [(batch, 3, 32, 32), (batch,)],
+        )
+        # audit=True: the sentry's stats ride the jitted round, so the
+        # journaled sentry EMA is real state, not a stub
+        self.solver = Solver(
+            models.load_model_solver("cifar10_quick"), net_param=netp,
+            audit=True,
+        )
+        if jax.device_count() < workers:
+            raise RuntimeError(
+                f"recover needs >= {workers} devices (virtual CPU mesh)"
+            )
+        self.mesh = make_mesh(
+            {"dp": workers}, devices=jax.devices()[:workers]
+        )
+        self.trainer = ParameterAveragingTrainer(
+            self.solver, self.mesh, compress=compress
+        )
+        self.prefix = os.path.join(workdir, "recover_ckpt")
+
+    def batch_for(self, r: int) -> Dict[str, np.ndarray]:
+        """Round ``r``'s host batch, a pure function of the absolute
+        round index (the shuffle-cursor discipline: resume re-derives
+        the same draw from the journaled cursor, no stateful sampler to
+        lose)."""
+        W, tau, B, n = self.workers, self.tau, self.batch, len(self.xs)
+        data = np.empty((W, tau) + self.xs[0].shape, np.float32)
+        label = np.empty((W, tau, B), np.float32)
+        for w in range(W):
+            for t in range(tau):
+                i = (r * W * tau + w * tau + t) % n
+                data[w, t] = self.xs[i]
+                label[w, t] = self.ys[i]
+        return {"data": data, "label": label}
+
+    def make_sentry(self):
+        from sparknet_tpu.obs.health import HealthSentry
+
+        return HealthSentry(policy="warn")
+
+
+def state_digest(state, comm_state=None, sentry_state=None) -> str:
+    """Deterministic digest of the FULL job state: every TrainState
+    leaf (params, stats, history, iter), the comm plane's EF residuals
+    and the sentry's EMA scalars.  Bit-identity of two runs == equal
+    digests."""
+    import jax
+
+    h = hashlib.sha256()
+    leaves = jax.tree_util.tree_leaves(jax.device_get(state))
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    if comm_state is not None:
+        resid = comm_state["resid"]
+        for i in range(len(resid)):
+            h.update(np.asarray(resid[str(i)]).tobytes())
+    if sentry_state is not None:
+        h.update(
+            json.dumps(
+                {
+                    k: sentry_state.get(k)
+                    for k in ("ema", "emvar", "seen", "cooldown")
+                },
+                sort_keys=True,
+            ).encode()
+        )
+    return h.hexdigest()
+
+
+def run_driver(
+    ctx: RecoverContext,
+    rounds: int,
+    *,
+    journal: bool = True,
+    resume: bool = False,
+    kill_at: Optional[Tuple[Optional[str], int]] = None,
+    kill: Optional[Callable[[], None]] = None,
+    fsync: str = "commit",
+    run_dir: Optional[str] = None,
+) -> Dict:
+    """One driver invocation (fresh or ``resume``); returns the run
+    record.  ``kill_at=(phase, round)`` arms the kill at that phase
+    boundary; ``kill`` defaults to a real SIGKILL (pass a raiser for
+    in-process harnesses).  ``run_dir`` overrides where the snapshots
+    + ledger live (in-process harnesses run control/crash/resume legs
+    in separate dirs off ONE compiled context)."""
+    import jax
+
+    from sparknet_tpu import obs as _obs
+    from sparknet_tpu.io import checkpoint
+    from sparknet_tpu.io.journal import RunJournal, default_journal_path
+    from sparknet_tpu.parallel import first_worker, shard_leading
+    from sparknet_tpu.parallel.hierarchy import HierarchySpec
+    from sparknet_tpu.runtime import membership as membership_mod
+
+    kill = kill or sigkill_self
+    kp, kr = kill_at or (None, -1)
+
+    def maybe_kill(phase: str, r: int) -> None:
+        if kp == phase and r == kr:
+            kill()
+
+    trainer = ctx.trainer
+    prefix = ctx.prefix
+    if run_dir is not None:
+        os.makedirs(run_dir, exist_ok=True)
+        prefix = os.path.join(run_dir, "recover_ckpt")
+    jr = (
+        RunJournal(default_journal_path(prefix), fsync=fsync)
+        if journal
+        else None
+    )
+    sentry = ctx.make_sentry()
+    # a (flat) membership controller rides along so the view epoch is
+    # real journaled state: the resumed epoch clock must continue, not
+    # rewind (flat spec + all-live mask => no effect on the math)
+    membership = membership_mod.MembershipController(
+        HierarchySpec.flat(ctx.workers)
+    )
+
+    start_round = 0
+    restore_s = None
+    resumed_from = None
+    info = None
+    try:
+        if resume:
+            t0 = time.perf_counter()
+            st = js = None
+            if jr is not None:
+                try:
+                    st, used, js, info = (
+                        checkpoint.restore_newest_valid_journaled(
+                            ctx.solver, prefix, jr
+                        )
+                    )
+                except FileNotFoundError:
+                    info = jr.reconcile()  # round 0 never committed
+                start_round = info["resume_round"]
+                if info["in_flight_round"] is not None:
+                    tm = _obs.training_metrics()
+                    if tm is not None:
+                        tm.recover_replayed.inc()
+            else:
+                try:
+                    st, used = checkpoint.restore_newest_valid(
+                        ctx.solver, prefix
+                    )
+                    start_round = int(np.asarray(st.iter)) // ctx.tau
+                except FileNotFoundError:
+                    pass
+            if st is not None:
+                resumed_from = os.path.basename(used)
+                state = trainer.broadcast_state(st)  # resets the plane
+                if js:
+                    if "comm" in js:
+                        trainer.restore_comm_state(js["comm"])
+                    if "sentry" in js:
+                        sentry.load_state(js["sentry"])
+                    if "membership" in js:
+                        membership.load_state(js["membership"])
+                    if "workers" in js:
+                        # PER-WORKER momentum history: the consensus
+                        # snapshot carries worker 0's only (broadcast
+                        # replicated it), but each worker's local-SGD
+                        # momentum differs — put the true stacks back
+                        hd = js["workers"]["history"]
+                        cur, treedef = jax.tree_util.tree_flatten(
+                            state.history
+                        )
+                        leaves = [
+                            np.asarray(hd[str(i)])
+                            for i in range(len(cur))
+                        ]
+                        if any(
+                            tuple(l.shape) != tuple(c.shape)
+                            for l, c in zip(leaves, cur)
+                        ):
+                            raise ValueError(
+                                "jobstate worker history does not "
+                                "match this trainer's shapes"
+                            )
+                        state = state._replace(
+                            history=shard_leading(
+                                jax.tree_util.tree_unflatten(
+                                    treedef, leaves
+                                ),
+                                ctx.mesh,
+                            )
+                        )
+            else:
+                trainer.reset_comm_state()
+                state = trainer.init_state(seed=ctx.seed)
+            restore_s = time.perf_counter() - t0
+        else:
+            trainer.reset_comm_state()
+            state = trainer.init_state(seed=ctx.seed)
+
+        rounds_executed: List[int] = []
+        round_ms: List[float] = []
+        losses = None
+        for r in range(start_round, rounds):
+            t_r = time.perf_counter()
+            view = membership.advance(r)
+            if jr is not None:
+                # the WRITE-AHEAD intent: everything restart needs to
+                # know what round ``r`` was (the exactly-once bracket)
+                jr.begin_round(
+                    r,
+                    iter=r * ctx.tau,
+                    view_epoch=view.epoch,
+                    cursor=r,
+                    rng="default_train_key(0)",
+                )
+            host = ctx.batch_for(r)
+            maybe_kill("assemble", r)
+            placed = shard_leading(host, ctx.mesh)
+            maybe_kill("h2d", r)
+            state, losses, stats = trainer.round(
+                state, placed, round_index=r
+            )
+            rounds_executed.append(r)
+            maybe_kill("execute", r)
+            sentry.observe(r, losses, stats)
+            maybe_kill("average", r)
+            # the durable boundary: full job state beside params, then
+            # the commit record referencing it
+            host_state = jax.device_get(state)
+            consensus = first_worker(host_state)
+            extra = {
+                "sentry": sentry.export_state(),
+                "membership": membership.export_state(),
+                "cursor": {"next_round": r + 1},
+                # per-worker momentum stacks (the consensus model/state
+                # files keep worker 0's view only)
+                "workers": {
+                    "history": {
+                        str(i): np.asarray(l)
+                        for i, l in enumerate(
+                            jax.tree_util.tree_leaves(host_state.history)
+                        )
+                    }
+                },
+            }
+            comm_state = trainer.export_comm_state()
+            if comm_state is not None:
+                extra["comm"] = comm_state
+            if kp == "snapshot_mid_write" and r == kr:
+                # the preemption lands while the solverstate tmp is
+                # written but unpublished — restore must never see it
+                checkpoint.set_crash_hook(
+                    lambda path: (
+                        kill()
+                        if path.endswith(".solverstate.npz")
+                        else None
+                    )
+                )
+            try:
+                _, state_path = checkpoint.snapshot(
+                    ctx.solver, consensus, prefix,
+                    fmt="BINARYPROTO", extra_state=extra,
+                )
+            finally:
+                checkpoint.set_crash_hook(None)
+            if jr is not None:
+                if kp == "journal_mid_append" and r == kr:
+                    jr.crash_hook = kill
+                jr.commit_round(
+                    r,
+                    iter=(r + 1) * ctx.tau,
+                    snapshot=os.path.basename(state_path),
+                )
+            round_ms.append((time.perf_counter() - t_r) * 1e3)
+
+        final_comm = trainer.export_comm_state()
+        final_sentry = sentry.export_state()
+        return {
+            "rounds": rounds,
+            "start_round": start_round,
+            "rounds_executed": rounds_executed,
+            "final_iter": int(
+                np.asarray(jax.device_get(state.iter)).reshape(-1)[0]
+            ),
+            "final_digest": state_digest(state, final_comm, final_sentry),
+            "final_loss": (
+                float(np.mean(np.asarray(jax.device_get(losses))))
+                if losses is not None
+                else None
+            ),
+            "sentry_ema": final_sentry["ema"],
+            "view_epoch": membership.view.epoch,
+            "journal": journal,
+            "journal_truncated_bytes": (
+                jr.truncated_bytes if jr is not None else 0
+            ),
+            "resumed_from": resumed_from,
+            "resume_info": info,
+            "restore_s": (
+                round(restore_s, 4) if restore_s is not None else None
+            ),
+            "round_ms": [round(m, 2) for m in round_ms],
+        }
+    finally:
+        if jr is not None:
+            jr.close()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--workdir", required=True)
+    p.add_argument("--rounds", type=int, default=4)
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--tau", type=int, default=2)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--compress", default="int8")
+    p.add_argument(
+        "--kill_at", default=None, metavar="PHASE:ROUND",
+        help="SIGKILL self at this phase boundary of this round "
+        f"(phases: {', '.join(KILL_POINTS)})",
+    )
+    p.add_argument("--resume", action="store_true")
+    p.add_argument(
+        "--no_journal", dest="journal", action="store_false",
+        default=True,
+        help="run without the ledger (the divergence control: resume "
+        "resets EF residuals / sentry state)",
+    )
+    p.add_argument("--fsync", default="commit")
+    args = p.parse_args(argv)
+
+    # the virtual mesh must exist before any backend use (same rule as
+    # bench.py's multi-device modes)
+    from sparknet_tpu.utils.devices import force_virtual_cpu_devices
+
+    force_virtual_cpu_devices(max(args.workers, 2))
+
+    ctx = RecoverContext(
+        args.workdir,
+        workers=args.workers,
+        tau=args.tau,
+        batch=args.batch,
+        seed=args.seed,
+        compress=args.compress,
+    )
+    rec = run_driver(
+        ctx,
+        args.rounds,
+        journal=args.journal,
+        resume=args.resume,
+        kill_at=parse_kill_at(args.kill_at),
+        fsync=args.fsync,
+    )
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

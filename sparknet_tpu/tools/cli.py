@@ -187,6 +187,18 @@ def _cmd_train(args) -> int:
         solver = Solver(solver_param)
     # one prefix rule for BOTH writing snapshots and --resume's scan
     prefix = solver_param.snapshot_prefix or "snapshot"
+    # --journal/--no_journal: the crash-consistency round ledger
+    # (io/journal.py) beside the snapshots.  Auto default: a --resume
+    # that finds an existing ledger consumes it (journal-guided
+    # restore rewinds to the last COMMITTED boundary).
+    from sparknet_tpu.io import journal as journal_mod
+
+    jr = journal_mod.journal_from_args(
+        args, journal_mod.default_journal_path(prefix),
+        resuming=args.resume,
+    )
+    if jr is not None:
+        print(f"run journal: {jr.path} (fsync={jr.fsync})")
     # training-health sentry (--health/--health_policy): flips the
     # solver's in-graph numerics audit on and guards every window;
     # rollback restores the newest verified snapshot under the same
@@ -201,9 +213,39 @@ def _cmd_train(args) -> int:
     if args.resume:
         # fault-tolerant resume: newest CRC-valid snapshot under the
         # solver's snapshot_prefix; corrupt ones are quarantined and the
-        # scan falls back (io/checkpoint.restore_newest_valid)
+        # scan falls back (io/checkpoint.restore_newest_valid).  With a
+        # run journal the restore is LEDGER-GUIDED: rewind to the last
+        # committed round boundary (a snapshot published for a round
+        # whose commit never landed is ignored, its round re-executes)
+        # and put the journaled driver state (sentry EMA/cooldown) back.
         try:
-            state, used = checkpoint.restore_newest_valid(solver, prefix)
+            if jr is not None and jr.last_committed_round is not None:
+                state, used, job_state, jinfo = (
+                    checkpoint.restore_newest_valid_journaled(
+                        solver, prefix, jr
+                    )
+                )
+                if (
+                    job_state
+                    and sentry is not None
+                    and "sentry" in job_state
+                ):
+                    sentry.load_state(job_state["sentry"])
+                if jinfo["in_flight_round"] is not None:
+                    from sparknet_tpu import obs as _obs_mod
+
+                    tm = _obs_mod.training_metrics()
+                    if tm is not None:
+                        tm.recover_replayed.inc()
+                    print(
+                        "journal: round %d was in flight at the crash "
+                        "— it re-executes (never skipped, never "
+                        "double-committed)" % jinfo["in_flight_round"]
+                    )
+            else:
+                state, used = checkpoint.restore_newest_valid(
+                    solver, prefix
+                )
         except (FileNotFoundError, checkpoint.SnapshotCorrupt) as e:
             print(f"train: --resume: {e}", file=sys.stderr)
             return 1
@@ -311,6 +353,32 @@ def _cmd_train(args) -> int:
         num_rounds=max(0, -(-(max_iter - it) // args.tau)),
     )
     r = 0
+
+    def job_extra():
+        # the full-job-state companion of a snapshot: driver-side
+        # scalars a plain TrainState restore silently resets
+        extra = {"cursor": {"iter": it, "round": it // args.tau}}
+        if sentry is not None:
+            extra["sentry"] = sentry.export_state()
+        return extra
+
+    # a journaled async boundary commits once its publish is CONFIRMED
+    # (the next save/wait joins the worker): (round, iter) awaiting ref
+    async_pending = None
+
+    def commit_async_published():
+        nonlocal async_pending
+        if jr is None or async_pending is None or ckpt is None:
+            return
+        paths_done = ckpt.last_paths
+        if paths_done:
+            pr, pit = async_pending
+            async_pending = None
+            jr.commit_round(
+                pr, iter=pit,
+                snapshot=os.path.basename(paths_done[1]),
+            )
+
     # the context manager guarantees the previous handler chain comes
     # back even when a step raises (no leaked handlers on exceptions)
     with SignalHandler(
@@ -319,6 +387,11 @@ def _cmd_train(args) -> int:
     ) as handler:
         try:
             while it < max_iter:
+                abs_r = it // args.tau
+                if jr is not None:
+                    # write-ahead intent: restart knows this round was
+                    # in flight whatever happens next
+                    jr.begin_round(abs_r, iter=it, cursor=abs_r)
                 batches = feed.next_round(r)
                 stepper = trainer if trainer is not None else solver
                 if sentry is not None:
@@ -345,17 +418,52 @@ def _cmd_train(args) -> int:
                     and it >= snap_every
                 ):
                     if ckpt is not None:
-                        ckpt.save(solver, state, prefix)
+                        # publish the PREVIOUS write and commit it
+                        # BEFORE the next save spawns: reading
+                        # last_paths after save() could race a fast
+                        # new write and attach ITS ref to the old
+                        # round's commit record
+                        ckpt.wait()
+                        commit_async_published()
+                        ckpt.save(
+                            solver, state, prefix,
+                            extra_state=job_extra(),
+                        )
+                        async_pending = (abs_r, it)
                         log.log(f"async snapshot started at iter {it}")
                     else:
-                        paths = checkpoint.snapshot(solver, state, prefix)
+                        paths = checkpoint.snapshot(
+                            solver, state, prefix,
+                            extra_state=job_extra(),
+                        )
+                        if jr is not None:
+                            # the durable boundary: commit rides the
+                            # published snapshot ref
+                            jr.commit_round(
+                                abs_r, iter=it,
+                                snapshot=os.path.basename(paths[1]),
+                            )
                         log.log(f"snapshotted to {paths[0]}")
                 if action == SolverAction.STOP:
                     log.log("stop requested; snapshotting and exiting")
                     if ckpt is not None:
-                        ckpt.save(solver, state, prefix)
+                        ckpt.wait()  # same ordering rule as above
+                        commit_async_published()
+                        ckpt.save(
+                            solver, state, prefix,
+                            extra_state=job_extra(),
+                        )
+                        async_pending = (abs_r, it)
                     else:
-                        checkpoint.snapshot(solver, state, prefix)
+                        paths = checkpoint.snapshot(
+                            solver, state, prefix,
+                            extra_state=job_extra(),
+                        )
+                        if jr is not None:
+                            jr.commit_round(
+                                abs_r, iter=it,
+                                snapshot=os.path.basename(paths[1]),
+                            )
                     break
         except health_mod.SentryHalt as e:
             # deliberately NO snapshot here: the live weights are the
@@ -365,6 +473,10 @@ def _cmd_train(args) -> int:
             log.log(f"training halted by the health sentry: {e}")
             if ckpt is not None:
                 ckpt.wait()  # publish any PRE-anomaly async snapshot
+                commit_async_published()
+                ckpt.close()  # detach the SIGTERM/atexit drain hooks
+            if jr is not None:
+                jr.close()
             return 1
         finally:
             # a step/snapshot exception must not leak the producer
@@ -372,8 +484,12 @@ def _cmd_train(args) -> int:
             feed.stop()
         if ckpt is not None:
             paths = ckpt.wait()
+            commit_async_published()
+            ckpt.close()
             if paths:
                 log.log(f"final async snapshot: {paths[0]}")
+    if jr is not None:
+        jr.close()
     if args.publish_to:
         # train-to-serve delivery (serve/publish.py): the final state
         # publishes ONLY with a passing sentry verdict attached to its
@@ -1025,10 +1141,12 @@ def main(argv=None) -> int:
         "cli serve --watch DIR canaries + promotes it with no restart",
     )
     from sparknet_tpu import obs as _obs
+    from sparknet_tpu.io import journal as _journal
     from sparknet_tpu.parallel import comm as _comm
 
     _obs.add_cli_args(p)  # --obs / --obs_port / --trace_out
     _comm.add_cli_args(p)  # --compress / --overlap_avg
+    _journal.add_cli_args(p)  # --journal / --no_journal / --journal_path
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("test")

@@ -123,6 +123,7 @@ def test_unknown_mode_rejected():
     assert "fleet" in out.stderr  # ... and the fleet-observability mode
     assert "delivery" in out.stderr  # ... and the serving-fleet delivery mode
     assert "elastic" in out.stderr  # ... and the elastic-membership mode
+    assert "recover" in out.stderr  # ... and the crash-consistency mode
     # env-var route rejects identically
     out = subprocess.run(
         [sys.executable, os.path.join(_REPO, "bench.py")],
@@ -510,12 +511,12 @@ _CHAOS_SCHEMA_KEYS = (
     "loss_band_ok", "final_iter", "seed", "workers", "rounds", "tau",
     "cache_stats", "collector_outage", "slice_preempt_round",
     "slice_leave_round", "slice_rejoin_round", "slice_masked_rounds",
-    "membership",
+    "membership", "driver_kill_round", "driver_kill",
 )
 
 
 def test_committed_chaos_artifact_schema():
-    """CHAOS_r16.json — the fault-tolerance committed artifact: every
+    """CHAOS_r17.json — the fault-tolerance committed artifact: every
     injected fault survived (the ISSUE 2 done-bar), every fault CLASS
     fired — including the round-12 data-plane faults (cache entry
     corrupted -> quarantined + refetched; cache wiped cold ->
@@ -523,13 +524,16 @@ def test_committed_chaos_artifact_schema():
     failed while down, buffered events replayed with 0 lost), the
     round-15 serving-fleet faults (a replica hard-killed mid-traffic
     ejected + respawned with zero client errors; a corrupt publish
-    rejected at CRC verify, never canaried), and the round-16 slice
+    rejected at CRC verify, never canaried), the round-16 slice
     preemption (a whole slice SIGTERM'd, departing at exactly the next
     round boundary, training masked, rejoining via snapshot ->
-    broadcast) — the run resumed from an OLDER verified snapshot after
-    the newest was corrupted+quarantined, and the final loss sat
-    inside the no-fault run's band."""
-    with open(os.path.join(_REPO, "CHAOS_r16.json")) as f:
+    broadcast), and the round-17 driver_kill (a journaled mini-driver
+    crashed mid-commit-append, torn ledger truncated, recovery
+    BIT-IDENTICAL with at most one replayed round) — the run resumed
+    from an OLDER verified snapshot after the newest was
+    corrupted+quarantined, and the final loss sat inside the no-fault
+    run's band."""
+    with open(os.path.join(_REPO, "CHAOS_r17.json")) as f:
         d = json.load(f)
     for key in _CHAOS_SCHEMA_KEYS:
         assert key in d, key
@@ -543,11 +547,16 @@ def test_committed_chaos_artifact_schema():
         "dead_worker", "nan_injection", "straggler_injection",
         "cache_corruption", "cache_cold", "collector_outage",
         "replica_death", "published_snapshot_corrupt",
-        "slice_preemption",
+        "slice_preemption", "driver_kill",
     ):
         v = d["faults"][kind]
         assert v["injected"] >= 1, kind
         assert v["survived"] == v["injected"], (kind, v)
+    dk = d["driver_kill"]
+    assert dk["crashed"] is True and dk["bit_identical"] is True
+    assert dk["journal_truncated_bytes"] > 0
+    assert dk["replayed_rounds"] <= 1
+    assert dk["resumed_digest"] == dk["control_digest"]
     # the slice preemption's leave landed at EXACTLY the boundary after
     # the SIGTERM, the masked rounds cover the departed span, and the
     # final membership view is fully live again
@@ -1095,3 +1104,80 @@ def test_committed_elastic_artifact_schema():
     assert d["intra_bytes_flat"] == 0  # K=1: every round is cross
     assert d["intra_bytes_two_tier"] > 0
     assert "modeled" in d["note"].lower()
+
+
+@pytest.mark.slow
+def test_recover_mode_smoke():
+    """bench.py --mode=recover end to end in a subprocess, trimmed to
+    one kill point via BENCH_RECOVER_ROUNDS (the committed artifact
+    pins the full 6-point sweep)."""
+    rec = _run_bench({"BENCH_MODE": "recover",
+                      "BENCH_RECOVER_ROUNDS": "3"})
+    assert rec["metric"] == "recover_killpoints_survived"
+    assert rec["killpoints_survived"] == rec["killpoints_total"] >= 6
+    assert rec["bit_identical_all"] is True
+    assert rec["max_replayed_rounds"] <= 1
+    assert rec["no_journal_diverged"] is True
+    assert rec["journal_bit_neutral"] is True
+
+
+_RECOVER_SCHEMA_KEYS = (
+    "metric", "value", "unit", "vs_baseline", "platform", "rounds",
+    "workers", "tau", "batch", "seed", "kill_round",
+    "killpoints_total", "killpoints_survived", "killpoints",
+    "bit_identical_all", "max_replayed_rounds", "control_digest",
+    "no_journal_diverged", "no_journal_digest", "journal_bit_neutral",
+    "journal_round_ms_p50", "nojournal_round_ms_p50",
+    "journal_overhead_pct", "note",
+)
+
+
+def test_committed_recover_artifact_schema():
+    """RECOVER_r17.json — the crash-consistency committed artifact
+    (ISSUE 14 done-bars): a REAL SIGKILL at every phase boundary of
+    the journaled driver (assemble, h2d, execute, average,
+    snapshot-mid-write, journal-append-mid-record), each resumed
+    BIT-IDENTICALLY to the uninterrupted control with at most one
+    replayed round; the --no_journal kill+resume DIVERGED (the zero is
+    not vacuous); the ledger itself is bit-neutral and its overhead
+    sits inside the noise floor."""
+    with open(os.path.join(_REPO, "RECOVER_r17.json")) as f:
+        d = json.load(f)
+    for key in _RECOVER_SCHEMA_KEYS:
+        assert key in d, key
+    assert d["metric"] == "recover_killpoints_survived"
+    assert d["unit"] == "killpoints"
+    assert d["value"] == d["killpoints_survived"] == (
+        d["killpoints_total"]
+    ) >= 6
+    assert d["vs_baseline"] == 1.0
+    from sparknet_tpu.runtime.recover import KILL_POINTS
+
+    seeded = {row["kill_at"].split(":")[0] for row in d["killpoints"]}
+    assert seeded == set(KILL_POINTS)  # every phase boundary covered
+    for row in d["killpoints"]:
+        assert row["killed"] is True, row  # the SIGKILL really landed
+        assert row["resumed_rc"] == 0, row
+        assert row["survived"] is True and row["bit_identical"] is True
+        assert row["replayed_rounds"] in (0, 1), row
+        assert row["recovery_latency_s"] is not None
+        assert row["recovery_latency_s"] < 60
+    # the torn-ledger kill really tore the ledger
+    torn = [r for r in d["killpoints"]
+            if r["kill_at"].startswith("journal_mid_append")]
+    assert torn and torn[0]["journal_truncated_bytes"] > 0
+    # the kills BEFORE the round executed replay nothing; the ones
+    # after replay exactly the in-flight round
+    by_phase = {r["kill_at"].split(":")[0]: r for r in d["killpoints"]}
+    assert by_phase["assemble"]["replayed_rounds"] == 0
+    assert by_phase["h2d"]["replayed_rounds"] == 0
+    for phase in ("execute", "average", "snapshot_mid_write",
+                  "journal_mid_append"):
+        assert by_phase[phase]["replayed_rounds"] == 1, phase
+    # non-vacuous zero: without the journal the same kill diverges,
+    # while the journal itself never perturbs the math
+    assert d["no_journal_diverged"] is True
+    assert d["no_journal_digest"] != d["control_digest"]
+    assert d["journal_bit_neutral"] is True
+    assert d["journal_overhead_pct"] < 3.0
+    assert "noise" in d["note"].lower()

@@ -5,7 +5,7 @@ preemption with simulated process death, newest-snapshot corruption
 quarantined + fallback restore, and a dead dp worker masked out of the
 average — and requires every injected fault survived plus a final loss
 inside the no-fault baseline's band (the acceptance bar for
-``CHAOS_r15.json``)."""
+``CHAOS_r17.json``)."""
 
 import dataclasses
 import os
@@ -76,6 +76,11 @@ def test_default_plan_covers_every_fault_class():
         plan.slice_preempt_round + plan.slice_relaunch_delta
         < plan.rounds
     )
+    # the driver_kill fault (round 17): the crash-consistency
+    # sub-scenario fires AFTER the preemption (on the resumed process,
+    # like the serve faults) and inside the run
+    assert plan.driver_kill_round is not None
+    assert plan.preempt_round < plan.driver_kill_round < plan.rounds
 
 
 def test_no_fault_view_strips_all_faults():
@@ -89,6 +94,7 @@ def test_no_fault_view_strips_all_faults():
     assert base.replica_death_round is None
     assert base.publish_corrupt_round is None
     assert base.slice_preempt_round is None
+    assert base.driver_kill_round is None
     # run geometry unchanged: the baseline is comparable — including
     # the two-tier hierarchy shape (both legs run the same schedule)
     plan2 = chaos.FaultPlan.default()
@@ -260,6 +266,17 @@ def test_chaos_smoke_default_plan(tmp_path):
         range(rep["slice_leave_round"], rep["slice_rejoin_round"])
     )
     assert all(s == "live" for s in rep["membership"]["states"])
+
+    # the driver_kill fault (round 17): the journaled mini-driver was
+    # crashed mid-commit-append, the torn ledger tail truncated on
+    # resume, at most one round replayed, and the recovered trajectory
+    # BIT-IDENTICAL to its uninterrupted control
+    assert rep["faults"]["driver_kill"]["survived"] == 1
+    dk = rep["driver_kill"]
+    assert dk["crashed"] and dk["bit_identical"]
+    assert dk["journal_truncated_bytes"] > 0
+    assert dk["replayed_rounds"] <= 1
+    assert dk["resumed_digest"] == dk["control_digest"]
 
     # quarantined files really are on disk, out of the resume scan
     corrupt = [f for f in os.listdir(str(tmp_path)) if f.endswith(".corrupt")]

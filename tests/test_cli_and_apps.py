@@ -411,6 +411,56 @@ def test_cli_train_profile_flag_prints_round_anatomy(
     obs._reset_training_metrics_for_tests()
 
 
+def test_cli_train_journal_and_journaled_resume(
+    tmp_path, toy_model, capsys
+):
+    """cli train --journal writes the intent/commit ledger beside the
+    snapshots (commits ride the published snapshot refs + jobstate
+    companion), and a later --resume consumes it AUTOMATICALLY —
+    journal-guided restore, no flag needed."""
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(
+        f'net: "{toy_model}"\n'
+        'base_lr: 0.05\nlr_policy: "fixed"\nmomentum: 0.9\n'
+        "snapshot: 2\n"
+        f'snapshot_prefix: "{tmp_path}/ck"\n'
+    )
+    rc = cli.main(
+        ["train", f"--solver={solver}", "--tau=2", "--max_iter=4",
+         "--journal"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "run journal:" in out
+    from sparknet_tpu.io import checkpoint
+    from sparknet_tpu.io import journal as journal_mod
+
+    jpath = journal_mod.default_journal_path(str(tmp_path / "ck"))
+    recs, torn = journal_mod.scan(jpath)
+    assert torn == 0
+    kinds = [r["kind"] for r in recs]
+    assert kinds == ["intent", "commit", "intent", "commit"]
+    # commits carry the published snapshot refs
+    snaps = checkpoint.find_snapshots(str(tmp_path / "ck"))
+    refs = [r["snapshot"] for r in recs if r["kind"] == "commit"]
+    assert refs == [os.path.basename(p) for p in snaps]
+    # the jobstate companion rode every snapshot (cursor at minimum)
+    js = checkpoint.load_job_state(snaps[-1])
+    assert js["cursor"]["iter"] == 4
+    # resume finds the ledger automatically and continues the schedule
+    rc = cli.main(
+        ["train", f"--solver={solver}", "--tau=2", "--max_iter=6",
+         "--resume"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "run journal:" in out
+    assert "resumed from" in out
+    recs, _ = journal_mod.scan(jpath)
+    committed = [r["round"] for r in recs if r["kind"] == "commit"]
+    assert committed == [0, 1, 2]  # no round re-committed, none skipped
+
+
 def test_cli_train_resume_conflicts_with_snapshot(tmp_path, toy_model, capsys):
     """--resume scans the solver's snapshot_prefix; naming an explicit
     --snapshot (or --weights) alongside it is a conflict, not a silent

@@ -158,6 +158,56 @@ def test_shipper_round_heartbeat_from_span_args_and_note_round():
     assert s._max_round == 9
 
 
+def test_shipper_stop_drains_backlog_and_marks_finished():
+    """Clean-exit contract: stop() must drain a backlog LARGER than one
+    push batch (one final flush used to strand the rest) and its last
+    payload carries the terminal heartbeat — the collector records the
+    host ``finished``, never later ``dead``."""
+    c = FleetCollector(port=0, dead_after_s=0.2).start()
+    try:
+        s = Shipper(
+            c.url, host="clean-host", interval_s=30,  # no periodic flush
+            max_batch=16,
+        )
+        s.start()
+        for i in range(50):
+            s.record_event({"kind": "instant", "name": f"e{i}",
+                            "t_s": time.time(), "thread": "t"})
+        s.note_round(7)
+        s.stop()  # the bounded drain: 50 events in ceil(50/16) pushes
+        st = c.fleet_view()["hosts"]["clean-host"]
+        assert st["received_events"] == 50
+        assert st["lost_events"] == 0
+        assert s.dropped_total == 0 and s.buffered() == 0
+        assert st["round"] == 7  # the last round's heartbeat shipped
+        # past the dead deadline a finished host stays finished
+        time.sleep(0.3)
+        view = c.fleet_view()
+        assert view["hosts"]["clean-host"]["state"] == "finished"
+        assert view["fleet"]["hosts_finished"] == 1
+        assert view["fleet"]["hosts_dead"] == 0
+        assert 'sparknet_fleet_hosts{state="finished"} 1' in (
+            c.render_metrics()
+        )
+    finally:
+        c.close()
+
+
+def test_collector_final_heartbeat_vs_silent_death():
+    """Same silence, different verdicts: the host that said goodbye is
+    ``finished``; the one that just vanished is ``dead``."""
+    c = FleetCollector(port=0, dead_after_s=0.2)
+    c.ingest(_push("clean", 0, round=5, final=True))
+    c.ingest(_push("vanished", 0, round=5))
+    time.sleep(0.3)
+    view = c.fleet_view()
+    assert view["hosts"]["clean"]["state"] == "finished"
+    assert view["hosts"]["vanished"]["state"] == "dead"
+    # a host pushing again after its terminal heartbeat is live again
+    c.ingest(_push("clean", 1, round=6))
+    assert c.fleet_view()["hosts"]["clean"]["state"] == "live"
+
+
 # ---------------------------------------------------------------------------
 # collector merge
 

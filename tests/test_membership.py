@@ -146,6 +146,33 @@ def test_view_epochs_monotonic_and_boundary_applied():
     assert kinds == ["leave", "death", "join_request", "rejoin"]
 
 
+def test_export_load_state_continues_the_epoch_clock():
+    """Full-job-state roundtrip (crash consistency, round 17): a
+    restarted driver loads the journaled roster and CONTINUES the view
+    history — same epoch, same states, same leave-grace bookkeeping —
+    instead of rewinding the epoch clock to zero."""
+    c = MembershipController(_spec())
+    c.note_preempt(slice_index=0)
+    c.advance(1)  # epoch 1: slice 0 leaving
+    d = c.export_state()
+    assert d["epoch"] == 1 and d["round"] == 1
+    c2 = MembershipController(_spec())
+    c2.load_state(d)
+    assert c2.view.epoch == 1 and c2.view.round == 1
+    assert c2.view.states == c.view.states
+    # the leave completes on schedule in the restarted controller
+    v = c2.advance(2)
+    assert v.states[:2] == (DEAD, DEAD) and v.epoch == 2
+    assert c2.epoch > d["epoch"]  # monotonic across the restart
+    # a roster sized for a different spec fails loudly
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="workers"):
+        MembershipController(
+            HierarchySpec.flat(2)
+        ).load_state(d)
+
+
 def test_late_heartbeat_demotes_to_leaving_not_dead():
     c = MembershipController(_spec())
     c.note_late([3])

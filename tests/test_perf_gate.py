@@ -320,6 +320,54 @@ def test_elastic_family_rules(tmp_path):
     )
 
 
+GOOD_RECOVER = {
+    "value": 6, "killpoints_total": 6, "killpoints_survived": 6,
+    "bit_identical_all": True, "max_replayed_rounds": 1,
+    "no_journal_diverged": True, "journal_bit_neutral": True,
+    "journal_overhead_pct": 0.4,
+}
+
+
+def test_recover_family_rules(tmp_path):
+    """The RECOVER family (ISSUE 14): every kill-point survived
+    bit-identically with at most one replayed round, the no-journal
+    control diverged (non-vacuous zero), the ledger bit-neutral, and
+    its overhead inside the noise floor — any one regressing fails
+    --check."""
+    g = _gate()
+    _write(tmp_path, "RECOVER_r17.json", GOOD_RECOVER)
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 0, rows
+    for bad_field, bad_value in (
+        ("bit_identical_all", False),    # a resume drifted bitwise
+        ("max_replayed_rounds", 2),      # exactly-once broke
+        ("no_journal_diverged", False),  # the zero went vacuous
+        ("journal_bit_neutral", False),  # the ledger perturbed the math
+        ("journal_overhead_pct", 7.5),   # the ledger got expensive
+        ("killpoints_total", 4),         # the sweep lost coverage
+    ):
+        _write(
+            tmp_path, "RECOVER_r18.json",
+            dict(GOOD_RECOVER, **{bad_field: bad_value}),
+        )
+        rc, rows = g.check(str(tmp_path))
+        assert rc == 1, bad_field
+        assert any(
+            bad_field in r["detail"] for r in rows if not r["ok"]
+        ), (bad_field, rows)
+    # the survival extra rule: survived must equal total even when
+    # both clear their static floors
+    _write(
+        tmp_path, "RECOVER_r18.json",
+        dict(GOOD_RECOVER, killpoints_total=7, value=6),
+    )
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 1
+    assert any(
+        "killpoints_survived" in r["detail"] for r in rows if not r["ok"]
+    )
+
+
 def test_missing_key_is_a_failure_not_a_pass(tmp_path):
     g = _gate()
     _write(tmp_path, "OBS_r09.json", {"overhead_traced_pct": 0.5})

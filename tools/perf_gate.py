@@ -210,6 +210,22 @@ RULES: Dict[str, List[Rule]] = {
         Rule("loss_band_ok", "is", True),
         Rule("cross_bytes_ratio", ">=", 3.9),
     ],
+    "RECOVER": [
+        # the crash-consistency contract (bench.py --mode=recover):
+        # every seeded kill-point survived with the resumed trajectory
+        # BIT-IDENTICAL to the uninterrupted control, at most one
+        # replayed round per recovery, the no-journal control visibly
+        # diverged (the zero is not vacuous), the journal itself
+        # bit-neutral on an uninterrupted run, and its overhead inside
+        # the +/-1-3% noise floor
+        Rule("value", ">=", 6),
+        Rule("killpoints_total", ">=", 6),
+        Rule("bit_identical_all", "is", True),
+        Rule("max_replayed_rounds", "<=", 1),
+        Rule("no_journal_diverged", "is", True),
+        Rule("journal_bit_neutral", "is", True),
+        Rule("journal_overhead_pct", "<", 3.0),
+    ],
     "DATACACHE": [
         # the I/O-flat contract: a warm (cache-filled, shuffled-
         # assignment) epoch makes ZERO network fetches and is strictly
@@ -292,10 +308,19 @@ def _elastic_ratio_rule(art: dict) -> Tuple[bool, str]:
     )
 
 
+def _recover_survival_rule(art: dict) -> Tuple[bool, str]:
+    ok = art.get("killpoints_survived") == art.get("killpoints_total")
+    return ok, (
+        "killpoints_survived=%r == killpoints_total=%r"
+        % (art.get("killpoints_survived"), art.get("killpoints_total"))
+    )
+
+
 _EXTRA_RULES = {
     "CHAOS": [_chaos_survival_rule],
     "PIPELINE": [_pipeline_order_rule],
     "ELASTIC": [_elastic_ratio_rule],
+    "RECOVER": [_recover_survival_rule],
 }
 
 
