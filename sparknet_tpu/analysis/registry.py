@@ -93,6 +93,10 @@ CANONICAL_METRICS = {
     "sparknet_journal_records_total": ("kind",),
     "sparknet_journal_truncated_total": (),
     "sparknet_recover_replayed_rounds_total": (),
+    # transformer-LM workload (apps/lm_app.py, --sp sequence
+    # parallelism over parallel/ring_attention.py)
+    "sparknet_lm_tokens_total": (),
+    "sparknet_lm_ring_hop_bytes_total": (),
     # fleet collector (obs/fleet.py, --fleet_collector) — the merged
     # cross-host families on the collector's own /metrics
     "sparknet_fleet_hosts": ("state",),
@@ -116,6 +120,9 @@ CANONICAL_SPANS = {
         "snapshot", "restore", "verify",
     }),
     "cache": frozenset({"cache_read", "cache_fetch"}),
+    # the LM data plane's host-side window sampling (apps/lm_app.py —
+    # nests under the producer thread's assemble span in traces)
+    "data": frozenset({"sample_text"}),
 }
 
 # the comm-plane span triple tools/trace_report.py folds into its

@@ -32,3 +32,9 @@ from sparknet_tpu.data.prefetch import (  # noqa: F401
     device_prefetch,
 )
 from sparknet_tpu.data.round_feed import RoundFeed, stack_windows  # noqa: F401
+from sparknet_tpu.data.text import (  # noqa: F401
+    ByteTokenizer,
+    TextWindowSampler,
+    load_corpus,
+    write_synthetic_corpus,
+)

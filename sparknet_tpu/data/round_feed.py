@@ -60,10 +60,16 @@ from sparknet_tpu.data.prefetch import (  # noqa: F401  (re-exported)
 
 
 def _host_nbytes(host) -> int:
-    """Byte size of a host batch dict (the H2D payload the h2d span
+    """Byte size of a host batch pytree (the H2D payload the h2d span
     carries so the profiler can report achieved transfer bandwidth)."""
     try:
-        return int(sum(int(v.nbytes) for v in host.values()))
+        return int(
+            sum(
+                int(v.nbytes)
+                for v in jax.tree_util.tree_leaves(host)
+                if hasattr(v, "nbytes")
+            )
+        )
     except (AttributeError, TypeError):
         return 0
 
@@ -72,14 +78,20 @@ Assemble = Callable[[int, Optional[Dict[str, np.ndarray]]],
 
 
 def stack_windows(windows, out=None):
-    """Stack per-worker ``{blob: (tau, ...)}`` dicts into
-    ``{blob: (num_workers, tau, ...)}`` — the worker-major round layout.
-    With ``out`` (a RoundFeed-recycled buffer) the stack writes in place
-    instead of allocating fresh arrays each round."""
+    """Stack per-worker batch pytrees ``{blob: (tau, ...)}`` (flat
+    dicts — the CNN apps — or ANY nested pytree: token/target dicts,
+    tuples, dicts of dicts) into the worker-major round layout
+    ``{blob: (num_workers, tau, ...)}``, leaf by leaf.  All windows
+    must share one tree structure.  With ``out`` (a RoundFeed-recycled
+    buffer of the same structure) the stack writes in place instead of
+    allocating fresh arrays each round."""
     if out is None:
-        return {k: np.stack([w[k] for w in windows]) for k in windows[0]}
-    for k, buf in out.items():
-        np.stack([w[k] for w in windows], out=buf)
+        return jax.tree_util.tree_map(
+            lambda *leaves: np.stack(leaves), *windows
+        )
+    jax.tree_util.tree_map(
+        lambda buf, *leaves: np.stack(leaves, out=buf), out, *windows
+    )
     return out
 
 
@@ -105,9 +117,11 @@ class RoundFeed:
     Placement, most specific wins: ``place`` (a callable
     ``host_dict -> device_batch`` — the multi-host loops pass
     ``shard_leading_global``), else ``sharding`` (used as
-    ``jax.device_put(host, sharding)``), else ``mesh``/``axis`` (the
-    cached ``NamedSharding(mesh, P(axis))`` — the single-host default),
-    else a plain ``jax.device_put``.
+    ``jax.device_put(host, sharding)`` — a single sharding broadcast
+    over every leaf, or a pytree of shardings matching the batch
+    structure, e.g. the LM's per-blob dp x sp placement), else
+    ``mesh``/``axis`` (the cached ``NamedSharding(mesh, P(axis))`` —
+    the single-host default), else a plain ``jax.device_put``.
 
     The consumer calls ``next_round(r)`` with consecutive absolute round
     indices; on a ``PrefetchStall`` it calls ``restart(r)`` and retries

@@ -8,6 +8,12 @@ covers the app-level preprocessing closures (random crop + mean subtract at
 
 Vectorized over the batch on the host (numpy); heavy decode/resize lives in
 the native runtime.
+
+Naming note: despite the filename, this is the Caffe ``DataTransformer``
+IMAGE AUGMENTER, not the transformer neural-network architecture.  The
+transformer (the decoder-only LM with ring attention) lives in
+``models/transformer_lm.py``, and its text data plane in
+``data/text.py`` — both cross-reference back here.
 """
 
 from __future__ import annotations

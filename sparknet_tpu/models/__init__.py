@@ -45,6 +45,16 @@ _SOLVER_FILES = {
 }
 
 
+def build_transformer_lm(**kwargs):
+    """The zoo's sequence model: a small decoder-only transformer LM
+    (``models/transformer_lm.py``) — NOT a prototxt net; it plugs into
+    ``Solver(..., net=lm)`` via the net protocol, with ring attention
+    over the ``sp`` mesh axis when ``sp_size > 1``."""
+    from sparknet_tpu.models.transformer_lm import TransformerLM
+
+    return TransformerLM(**kwargs)
+
+
 def available_models() -> List[str]:
     from sparknet_tpu.models.builders import BUILDERS
 

@@ -374,6 +374,19 @@ class TrainingMetrics:
             "in-flight round whose commit never landed; at most one "
             "per recovery when every boundary snapshots)",
         )
+        # transformer-LM workload series (apps/lm_app.py, --sp) — zero
+        # for the CNN apps
+        self.lm_tokens = registry.counter(
+            "sparknet_lm_tokens_total",
+            "tokens trained by the LM workload (dp workers x tau x "
+            "batch x seq_len per round)",
+        )
+        self.lm_ring_bytes = registry.counter(
+            "sparknet_lm_ring_hop_bytes_total",
+            "modeled ring-attention KV exchange bytes (sequence "
+            "parallelism: K+V shards x (sp-1) hops x layers, "
+            "forward + transposed backward; zero when sp=1)",
+        )
 
 
 _lock = threading.Lock()

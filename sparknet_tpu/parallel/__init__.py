@@ -28,8 +28,10 @@ from sparknet_tpu.parallel.mesh import (  # noqa: F401
 from sparknet_tpu.parallel.trainers import (  # noqa: F401
     AllReduceTrainer,
     ParameterAveragingTrainer,
+    export_worker_history,
     first_worker,
     leading_sharding,
+    restore_worker_history,
     local_worker_slice,
     replicate,
     replicate_global,

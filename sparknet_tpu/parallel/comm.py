@@ -172,6 +172,7 @@ class CommPlane:
         cost_ms_per_mb: Optional[float] = None,
         average_stats: bool = True,
         mask_nonfinite: bool = True,
+        batch_spec=None,
     ):
         if compress not in COMPRESS_MODES:
             raise ValueError(
@@ -268,12 +269,24 @@ class CommPlane:
         # anchor, so their buffers must outlive the local program (the
         # fused default path keeps its donating round; delta averaging
         # inherently carries one extra param copy — PERF.md).
+        # batch_spec: the trainer's generalized batch partitioning
+        # (sequence parallelism) — same in_spec + check_rep backport
+        # rules as the fused round (trainers.py)
+        if batch_spec is None:
+            batch_in_spec, shmap_kw = P(axis), {}
+        else:
+            from sparknet_tpu.parallel.ring_attention import (
+                seq_shmap_kwargs,
+            )
+
+            batch_in_spec, shmap_kw = batch_spec, seq_shmap_kwargs()
         self._local = jax.jit(
             shard_map(
                 local_body,
                 mesh=mesh,
-                in_specs=(P(axis), P(axis), P(), P(axis)),
+                in_specs=(P(axis), batch_in_spec, P(), P(axis)),
                 out_specs=out_specs,
+                **shmap_kw,
             )
         )
         obs.track_jit(self._local)

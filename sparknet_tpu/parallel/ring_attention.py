@@ -44,6 +44,16 @@ except AttributeError:  # pragma: no cover - version-dependent
     _SHMAP_KW = {"check_rep": False}
 
 
+def seq_shmap_kwargs() -> dict:
+    """Extra ``shard_map`` kwargs any program needs when its body
+    carries ring collectives (ppermute loop carries / sp psums) under
+    autodiff on this jax build — the check_rep backport, shared with
+    the trainers so their sequence-parallel rounds lower on the same
+    jax versions this module does.  Empty on varying-typed jax
+    (>= 0.7), ``{"check_rep": False}`` before it."""
+    return dict(_SHMAP_KW)
+
+
 def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     """Attention over ring-sharded KV. Call under shard_map; q/k/v are the
     local shards (B, T_local, H, D); returns the local output shard."""
@@ -98,9 +108,13 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
 def ring_self_attention(
     mesh: Mesh, axis: str = "sp", causal: bool = False
 ):
-    """Returns a jitted fn (q, k, v) -> out with q/k/v (B, T, H, D) sharded
-    along T over ``axis``; the driver-facing wrapper."""
+    """Returns a fn (q, k, v) -> out with q/k/v (B, T, H, D) sharded
+    along T over ``axis``; the driver-facing wrapper.  T must divide
+    evenly by the axis size (the ring rotates equal shards) — a ragged
+    T is rejected up front with the fix spelled out, instead of the
+    shard_map partitioner's generic shape error."""
     spec = P(None, axis, None, None)
+    n = mesh.shape[axis]
 
     @jax.jit
     @partial(
@@ -110,7 +124,23 @@ def ring_self_attention(
         out_specs=spec,
         **_SHMAP_KW,
     )
-    def fn(q, k, v):
+    def inner(q, k, v):
         return ring_attention(q, k, v, axis, causal=causal)
+
+    def fn(q, k, v):
+        for name, arr in (("q", q), ("k", k), ("v", v)):
+            if arr.ndim != 4:
+                raise ValueError(
+                    f"ring_self_attention: {name} must be (B, T, H, D), "
+                    f"got shape {tuple(arr.shape)}"
+                )
+            if arr.shape[1] % n:
+                raise ValueError(
+                    f"ring_self_attention: {name} has T={arr.shape[1]} "
+                    f"which does not divide over the {n}-way {axis!r} "
+                    "ring — pad the sequence or pick T a multiple of "
+                    f"{n}"
+                )
+        return inner(q, k, v)
 
     return fn

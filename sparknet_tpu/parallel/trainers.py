@@ -102,6 +102,46 @@ def replicate(tree, mesh: Mesh):
     return jax.device_put(tree, replicated_sharding(mesh))
 
 
+def export_worker_history(host_state) -> Dict:
+    """Per-worker momentum stacks as a ``.jobstate.npz`` fragment
+    (the ``workers`` key of the journaled-state inventory): the
+    consensus snapshot keeps worker 0's history only — broadcast
+    would replicate it over every worker — so the true stacks ride
+    beside it.  One implementation shared by every journaled driver
+    (``runtime/recover.py``, ``apps/lm_app.py``)."""
+    return {
+        "history": {
+            str(i): np.asarray(l)
+            for i, l in enumerate(
+                jax.tree_util.tree_leaves(host_state.history)
+            )
+        }
+    }
+
+
+def restore_worker_history(state, workers_fragment, mesh: Mesh,
+                           axis: str = "dp"):
+    """Put journaled per-worker momentum stacks back onto a
+    broadcast-restored state (the inverse of
+    ``export_worker_history``); shape mismatches fail loudly — the
+    jobstate belongs to a different trainer geometry."""
+    hd = workers_fragment["history"]
+    cur, treedef = jax.tree_util.tree_flatten(state.history)
+    leaves = [np.asarray(hd[str(i)]) for i in range(len(cur))]
+    if any(
+        tuple(l.shape) != tuple(c.shape) for l, c in zip(leaves, cur)
+    ):
+        raise ValueError(
+            "jobstate worker history does not match this trainer's "
+            "shapes"
+        )
+    return state._replace(
+        history=shard_leading(
+            jax.tree_util.tree_unflatten(treedef, leaves), mesh, axis
+        )
+    )
+
+
 def first_worker(stacked_tree):
     """Slice worker 0 out of a *worker-stacked* tree (leaves carry a leading
     ``num_workers`` axis — the ParameterAveragingTrainer state layout).  Not
@@ -192,6 +232,7 @@ class ParameterAveragingTrainer:
         overlap_steps: Optional[int] = None,
         comm_cost_ms_per_mb: Optional[float] = None,
         hierarchy: Optional[HierarchySpec] = None,
+        batch_spec=None,
     ):
         """``average_params=False`` skips the cross-worker pmean — a
         DIAGNOSTIC mode (workers then train fully independently): the
@@ -226,7 +267,20 @@ class ParameterAveragingTrainer:
         round — the same jitted program as today, so compression and
         overlap compose unchanged on the cross-slice tier.  A flat
         spec (one slice, or K == 1) yields the single-tier schedule
-        and is bit-identical to ``hierarchy=None`` by construction."""
+        and is bit-identical to ``hierarchy=None`` by construction.
+
+        ``batch_spec`` generalizes the round's batch partitioning
+        beyond the worker-major CNN layout: a ``PartitionSpec`` (or a
+        pytree of them matching the batch dict) used as the shard_map
+        in_spec for ``batches`` — e.g. the transformer LM passes
+        ``{"tokens": P("dp", None, None, "sp"), ...}`` so each round's
+        (num_workers, tau, B, T) token arrays shard their sequence
+        dim over the ``sp`` ring while the leading dim keeps the dp
+        worker split.  ``None`` keeps today's ``P(axis)`` (every CNN
+        app, bit-identical).  A spec naming axes beyond ``axis``
+        implies ring collectives inside the body, which needs the
+        check_rep backport on pre-varying jax
+        (``ring_attention.seq_shmap_kwargs``)."""
         self.solver = solver
         self.mesh = mesh
         self.axis = axis
@@ -235,6 +289,21 @@ class ParameterAveragingTrainer:
         self.mask_nonfinite = bool(mask_nonfinite) and self.audit
         self.average_params = bool(average_params)
         self.average_stats = bool(average_stats)
+        # batch pytree partitioning: P(axis) (worker-major, the CNN
+        # apps) unless the caller declares per-leaf specs (sequence
+        # parallelism).  Extra axes in the spec mean ring collectives
+        # run inside the round body, which trips pre-varying jax's
+        # replication checker — same backport as ring_attention.
+        self.batch_spec = batch_spec
+        batch_in_spec = P(axis) if batch_spec is None else batch_spec
+        if batch_spec is None:
+            shmap_kw = {}
+        else:
+            from sparknet_tpu.parallel.ring_attention import (
+                seq_shmap_kwargs,
+            )
+
+            shmap_kw = seq_shmap_kwargs()
 
         # the comm plane (parallel/comm.py): engaged for compressed
         # and/or overlapped averaging; None on the default path, which
@@ -265,6 +334,7 @@ class ParameterAveragingTrainer:
                 cost_ms_per_mb=comm_cost_ms_per_mb,
                 average_stats=average_stats,
                 mask_nonfinite=mask_nonfinite,
+                batch_spec=batch_spec,
             )
         self._fused_payload_bytes: Optional[int] = None
 
@@ -377,8 +447,9 @@ class ParameterAveragingTrainer:
             shard_map(
                 round_body,
                 mesh=mesh,
-                in_specs=(P(axis), P(axis), P(), P(axis)),
+                in_specs=(P(axis), batch_in_spec, P(), P(axis)),
                 out_specs=out_specs,
+                **shmap_kw,
             ),
             donate_argnums=(0, 1),
         )
@@ -483,8 +554,9 @@ class ParameterAveragingTrainer:
                 shard_map(
                     slice_body,
                     mesh=mesh,
-                    in_specs=(P(axis), P(axis), P(), P(axis)),
+                    in_specs=(P(axis), batch_in_spec, P(), P(axis)),
                     out_specs=out_specs,
+                    **shmap_kw,
                 ),
                 donate_argnums=(0, 1),
             )

@@ -224,7 +224,12 @@ def run_driver(
     from sparknet_tpu import obs as _obs
     from sparknet_tpu.io import checkpoint
     from sparknet_tpu.io.journal import RunJournal, default_journal_path
-    from sparknet_tpu.parallel import first_worker, shard_leading
+    from sparknet_tpu.parallel import (
+        export_worker_history,
+        first_worker,
+        restore_worker_history,
+        shard_leading,
+    )
     from sparknet_tpu.parallel.hierarchy import HierarchySpec
     from sparknet_tpu.runtime import membership as membership_mod
 
@@ -298,29 +303,8 @@ def run_driver(
                         # snapshot carries worker 0's only (broadcast
                         # replicated it), but each worker's local-SGD
                         # momentum differs — put the true stacks back
-                        hd = js["workers"]["history"]
-                        cur, treedef = jax.tree_util.tree_flatten(
-                            state.history
-                        )
-                        leaves = [
-                            np.asarray(hd[str(i)])
-                            for i in range(len(cur))
-                        ]
-                        if any(
-                            tuple(l.shape) != tuple(c.shape)
-                            for l, c in zip(leaves, cur)
-                        ):
-                            raise ValueError(
-                                "jobstate worker history does not "
-                                "match this trainer's shapes"
-                            )
-                        state = state._replace(
-                            history=shard_leading(
-                                jax.tree_util.tree_unflatten(
-                                    treedef, leaves
-                                ),
-                                ctx.mesh,
-                            )
+                        state = restore_worker_history(
+                            state, js["workers"], ctx.mesh
                         )
             else:
                 trainer.reset_comm_state()
@@ -367,14 +351,7 @@ def run_driver(
                 "cursor": {"next_round": r + 1},
                 # per-worker momentum stacks (the consensus model/state
                 # files keep worker 0's view only)
-                "workers": {
-                    "history": {
-                        str(i): np.asarray(l)
-                        for i, l in enumerate(
-                            jax.tree_util.tree_leaves(host_state.history)
-                        )
-                    }
-                },
+                "workers": export_worker_history(host_state),
             }
             comm_state = trainer.export_comm_state()
             if comm_state is not None:

@@ -170,6 +170,8 @@ class Solver:
         train_transform=None,
         test_transform=None,
         audit: bool = False,
+        net=None,
+        grad_reduce_axes: Sequence[str] = (),
     ):
         # Per-phase preprocessing closures traced into the jitted step —
         # the reference's imageNetTrain/TestPreprocessing host closures
@@ -189,19 +191,40 @@ class Solver:
         self.param = param
         self.compute_dtype = compute_dtype
         self.method = solver_method(param)
-        if net_param is not None:
-            netp = net_param
+        # cross-shard gradient reduction axes: a model whose forward is
+        # sharded over extra mesh axes (sequence parallelism — the
+        # transformer LM over ``sp``) computes PARTIAL param grads per
+        # shard; the step psums them over these axes so the replicated
+        # params update identically on every shard.  Only valid inside
+        # shard_map with the axes bound (the averaging trainer's round
+        # with a matching batch_spec) — the bare jitted ``step`` has no
+        # named axes and will fail loudly.
+        self.grad_reduce_axes = tuple(grad_reduce_axes or ())
+        if net is not None:
+            # any loss-bearing apply-fn object (init / loss_fn /
+            # param_multipliers / feed_blobs — models/transformer_lm.py
+            # is the reference implementation): the prototxt graph
+            # machinery is bypassed entirely, everything downstream
+            # (update rules, audit, trainers, checkpoints) is pytree-
+            # generic and composes unchanged.
+            if net_param is not None:
+                raise ValueError("pass net= or net_param=, not both")
+            self.net_param = getattr(net, "net_param", None)
+            self.net = net
         else:
-            from sparknet_tpu.config import resolve_solver_net
+            if net_param is not None:
+                netp = net_param
+            else:
+                from sparknet_tpu.config import resolve_solver_net
 
-            netp = resolve_solver_net(param)
-        self.net_param = netp
-        self.net = JaxNet(
-            netp,
-            phase="TRAIN",
-            feed_shapes=feed_shapes,
-            compute_dtype=compute_dtype,
-        )
+                netp = resolve_solver_net(param)
+            self.net_param = netp
+            self.net = JaxNet(
+                netp,
+                phase="TRAIN",
+                feed_shapes=feed_shapes,
+                compute_dtype=compute_dtype,
+            )
         self._test_feed_shapes = test_feed_shapes or feed_shapes
         self._test_net: Optional[JaxNet] = None
         self._lr_mults, self._decay_mults = self.net.param_multipliers()
@@ -223,6 +246,12 @@ class Solver:
         (Solver::InitTestNets, solver.cpp:104-190), and a train-only config
         has no valid TEST filtering."""
         if self._test_net is None:
+            if self.net_param is None:
+                raise ValueError(
+                    "this solver wraps a net object (net=...) with no "
+                    "prototxt TEST view — score through the net's own "
+                    "forward/loss_fn instead"
+                )
             self._test_net = JaxNet(
                 self.net_param,
                 phase="TEST",
@@ -246,6 +275,15 @@ class Solver:
     # ------------------------------------------------------------------
     # One iteration: iter_size microbatches -> grads -> update
     # ------------------------------------------------------------------
+    def _reduce_grads(self, g):
+        """psum partial grads over the model's extra sharding axes
+        (``grad_reduce_axes`` — sequence parallelism).  The loss itself
+        is already globally reduced by the model's loss_fn, so summing
+        the per-shard grads yields exactly the global gradient."""
+        for ax in self.grad_reduce_axes:
+            g = _tree_map(lambda t: jax.lax.psum(t, ax), g)
+        return g
+
     def _grads(self, params, stats, batch, rng):
         grad_fn = jax.value_and_grad(self.net.loss_fn, has_aux=True)
         if self.param.iter_size == 1:
@@ -254,7 +292,7 @@ class Solver:
                     batch, jax.random.fold_in(rng, 0x7F)
                 )
             (loss, (_, new_stats)), g = grad_fn(params, stats, batch, rng, True)
-            return g, loss, new_stats
+            return self._reduce_grads(g), loss, new_stats
 
         def micro(carry, mb):
             acc, st, i = carry
@@ -266,7 +304,7 @@ class Solver:
 
         zero = _zeros_like(params)
         (g, new_stats, _), losses = jax.lax.scan(micro, (zero, stats, 0), batch)
-        return g, jnp.mean(losses), new_stats
+        return self._reduce_grads(g), jnp.mean(losses), new_stats
 
     def _apply_update(self, params, history, grads, it):
         p = self.param

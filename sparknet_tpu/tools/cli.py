@@ -1084,10 +1084,31 @@ def cmd_compute_image_mean(args) -> int:
 
 
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if argv[:1] == ["train"] and "--lm" in argv:
+        # the transformer-LM workload: ``train --lm`` hands the rest of
+        # the line to apps/lm_app.py, whose parser carries the LM's
+        # full surface — --sp (sequence-parallel ring width, dp x sp
+        # mesh), --corpus/--cache_dir, --seq_len/--dim/--depth/--heads,
+        # plus the same --obs/--health/--journal/--elastic/--compress
+        # groups every averaging app exposes.  A prototxt --solver does
+        # not apply (the LM is builder-backed, models/transformer_lm).
+        from sparknet_tpu.apps import lm_app
+
+        return lm_app.main([a for a in argv[1:] if a != "--lm"])
     parser = argparse.ArgumentParser(prog="sparknet_tpu", description=__doc__)
     sub = parser.add_subparsers(dest="command", required=True)
 
     p = sub.add_parser("train")
+    p.add_argument(
+        "--lm", action="store_true",
+        help="train the transformer LM workload instead of a prototxt "
+        "solver: the rest of the line goes to apps/lm_app.py "
+        "(--sp RING_WIDTH for sequence parallelism over a dp x sp "
+        "mesh, --corpus URL/dir, --seq_len/--dim/--depth/--heads, "
+        "full --obs/--health/--journal/--elastic surface; --solver "
+        "does not apply)",
+    )
     p.add_argument("--solver", required=True)
     p.add_argument("--snapshot", default=None)
     p.add_argument("--resume", action="store_true",

@@ -463,3 +463,55 @@ def test_cli_contract(tmp_path, capsys):
     assert isinstance(rows, list) and any(not r["ok"] for r in rows)
     with pytest.raises(SystemExit):
         g.main([])  # neither --check nor --live is an error
+
+
+GOOD_LM = {
+    "value": 30000.0, "sp": 2, "rounds": 12,
+    "sp_tolerance": 5e-4, "sp_max_abs_param_diff": 2.4e-7,
+    "sp_trajectory_ok": True, "loss_strictly_decreasing": True,
+    "ring_hop_bytes_per_round": 4194304, "tokens_per_round": 2048,
+}
+
+
+def test_lm_family_rules(tmp_path):
+    """The LM family (ISSUE 15): the sp=2 ring-attention run must
+    reproduce the sp=1 dense run within the pinned associativity
+    tolerance, the seeded run must actually learn (strictly
+    decreasing loss), and a real sp>1 mesh with modeled ring bytes
+    must have been measured — any one regressing fails --check."""
+    g = _gate()
+    _write(tmp_path, "LM_r18.json", GOOD_LM)
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 0, rows
+    for bad_field, bad_value in (
+        ("sp_trajectory_ok", False),       # ring drifted off dense
+        ("loss_strictly_decreasing", False),  # the LM stopped learning
+        ("sp", 1),                         # the ring leg never ran
+        ("ring_hop_bytes_per_round", 0),   # no modeled exchange
+        ("rounds", 2),                     # too short to mean anything
+    ):
+        _write(
+            tmp_path, "LM_r19.json", dict(GOOD_LM, **{bad_field: bad_value})
+        )
+        rc, rows = g.check(str(tmp_path))
+        assert rc == 1, bad_field
+        assert any(
+            bad_field in r["detail"] for r in rows if not r["ok"]
+        ), (bad_field, rows)
+    # the tolerance extra rule: a measured diff past the artifact's
+    # OWN pin fails even with sp_trajectory_ok mistakenly True
+    _write(
+        tmp_path, "LM_r19.json",
+        dict(GOOD_LM, sp_max_abs_param_diff=1e-2),
+    )
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 1
+    assert any(
+        "sp_tolerance" in r["detail"] for r in rows if not r["ok"]
+    )
+    # a missing diff field is a failure, not a silent pass
+    bad = dict(GOOD_LM)
+    del bad["sp_max_abs_param_diff"]
+    _write(tmp_path, "LM_r19.json", bad)
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 1

@@ -286,3 +286,139 @@ def test_pipelined_round_loop_bit_identical_to_serial():
     assert flat_a, "empty state?"
     for la, lb in zip(flat_a, flat_b):
         np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+# ---------------------------------------------------------------------------
+# batch-pytree generalization (ISSUE 15): dict-shaped and nested
+# batches flow through stack_windows and RoundFeed with the CNN apps'
+# behavior pinned unchanged
+# ---------------------------------------------------------------------------
+
+
+def test_stack_windows_dict_and_nested_pytrees():
+    # token/target dicts (the LM shape)
+    windows = [
+        {"tokens": np.full((2, 4), w, np.int32),
+         "targets": np.full((2, 4), 10 + w, np.int32)}
+        for w in range(3)
+    ]
+    out = stack_windows(windows)
+    assert set(out) == {"tokens", "targets"}
+    assert out["tokens"].shape == (3, 2, 4)
+    np.testing.assert_array_equal(out["tokens"][1], windows[1]["tokens"])
+    # nested pytrees (dict-of-dict + tuple leaves) stack leaf-by-leaf
+    nested = [
+        {"inp": {"a": np.full((2,), w, np.float32)},
+         "aux": (np.full((3,), -w, np.float32),)}
+        for w in range(2)
+    ]
+    out = stack_windows(nested)
+    assert out["inp"]["a"].shape == (2, 2)
+    assert out["aux"][0].shape == (2, 3)
+    np.testing.assert_array_equal(out["aux"][0][1], nested[1]["aux"][0])
+
+
+def test_stack_windows_nested_recycle_writes_in_place():
+    windows = [
+        {"tok": {"ids": np.full((2, 2), w, np.int32)}} for w in range(2)
+    ]
+    first = stack_windows(windows)
+    buf = first["tok"]["ids"]
+    windows2 = [
+        {"tok": {"ids": np.full((2, 2), 7 + w, np.int32)}} for w in range(2)
+    ]
+    second = stack_windows(windows2, out=first)
+    assert second is first and second["tok"]["ids"] is buf  # in place
+    np.testing.assert_array_equal(
+        buf, np.stack([w["tok"]["ids"] for w in windows2])
+    )
+
+
+def test_round_feed_dict_batches_recycle_and_order():
+    """The LM's {tokens, targets} batches through the pipelined feed:
+    ordering preserved, the recycle handback returns the same dict."""
+    seen = []
+
+    def assemble(r, out):
+        seen.append(out)
+        windows = [
+            {"tokens": np.full((2, 3), 10 * r + w, np.int32),
+             "targets": np.full((2, 3), 100 * r + w, np.int32)}
+            for w in range(2)
+        ]
+        return stack_windows(windows, out)
+
+    feed = RoundFeed(
+        assemble,
+        place=lambda h: {k: v.copy() for k, v in h.items()},
+        pipelined=True, num_rounds=3, recycle=True,
+    )
+    try:
+        outs = [feed.next_round(r) for r in range(3)]
+    finally:
+        feed.stop()
+    assert seen[0] is None and seen[2] is seen[1]  # recycled dict back
+    for r, out in enumerate(outs):
+        assert out["tokens"][1, 0, 0] == 10 * r + 1
+        assert out["targets"][0, 0, 0] == 100 * r
+
+
+def test_round_feed_dict_batches_cpu_alias_gate():
+    """The cpu zero-copy gate holds for pytree batches too: auto mode
+    hands assemble out=None every round (the sharded put aliases)."""
+    assert sharded_put_may_alias() is True
+    seen = []
+
+    def assemble(r, out):
+        seen.append(out)
+        return {"tokens": np.full((2, 2), r, np.int32),
+                "targets": np.full((2, 2), r, np.int32)}
+
+    feed = RoundFeed(assemble, place=lambda h: h, num_rounds=3)
+    try:
+        for r in range(3):
+            feed.next_round(r)
+    finally:
+        feed.stop()
+    assert seen == [None, None, None]
+
+
+def test_round_feed_dict_batches_stall_restart():
+    """PrefetchStall -> restart(r) recovery with dict-shaped batches:
+    the restarted generation re-draws the SAME round (exactly-once
+    hand-off to the consumer)."""
+    import time as _time
+
+    calls = []
+
+    def assemble(r, out):
+        calls.append(r)
+        if len(calls) == 2:  # wedge the producer on its 2nd draw
+            _time.sleep(1.2)
+        return {"tokens": np.full((1, 2), r, np.int32),
+                "targets": np.full((1, 2), -r, np.int32)}
+
+    feed = RoundFeed(
+        assemble, place=lambda h: h, pipelined=True,
+        num_rounds=4, stall_timeout_s=0.3,
+    )
+    try:
+        out0 = feed.next_round(0)
+        assert out0["tokens"][0, 0] == 0
+        try:
+            out1 = feed.next_round(1)
+        except PrefetchStall:
+            feed.restart(1)
+            out1 = feed.next_round(1)
+        assert out1["tokens"][0, 0] == 1 and out1["targets"][0, 0] == -1
+    finally:
+        feed.stop()
+
+
+def test_host_nbytes_counts_pytree_leaves():
+    from sparknet_tpu.data.round_feed import _host_nbytes
+
+    host = {"a": np.zeros((2, 2), np.float32),
+            "b": {"c": np.zeros((4,), np.int32)}}
+    assert _host_nbytes(host) == 16 + 16
+    assert _host_nbytes({"x": object()}) == 0  # unknown leaves -> 0

@@ -226,6 +226,23 @@ RULES: Dict[str, List[Rule]] = {
         Rule("journal_bit_neutral", "is", True),
         Rule("journal_overhead_pct", "<", 3.0),
     ],
+    "LM": [
+        # the transformer-LM workload contract (bench.py --mode=lm):
+        # the sp=2 ring-attention run reproduces the sp=1 dense run's
+        # trajectory within the pinned associativity tolerance (the
+        # extra rule below compares the measured diff against the
+        # artifact's own pin), the seeded run's loss strictly
+        # decreases (the identity is not two broken runs agreeing),
+        # and the modeled ring-hop KV bytes are recorded for a real
+        # sp>1 mesh
+        Rule("value", ">", 0),
+        Rule("sp", ">=", 2),
+        Rule("rounds", ">=", 4),
+        Rule("sp_trajectory_ok", "is", True),
+        Rule("loss_strictly_decreasing", "is", True),
+        Rule("ring_hop_bytes_per_round", ">", 0),
+        Rule("tokens_per_round", ">", 0),
+    ],
     "DATACACHE": [
         # the I/O-flat contract: a warm (cache-filled, shuffled-
         # assignment) epoch makes ZERO network fetches and is strictly
@@ -308,6 +325,20 @@ def _elastic_ratio_rule(art: dict) -> Tuple[bool, str]:
     )
 
 
+def _lm_tolerance_rule(art: dict) -> Tuple[bool, str]:
+    """The measured sp=1-vs-sp=2 trajectory diff must sit inside the
+    artifact's OWN pinned associativity tolerance, whatever tolerance
+    the bench ran with."""
+    tol = art.get("sp_tolerance")
+    diff = art.get("sp_max_abs_param_diff")
+    ok = bool(
+        tol is not None and diff is not None and 0 <= diff <= tol
+    )
+    return ok, (
+        "sp_max_abs_param_diff=%r <= sp_tolerance=%r" % (diff, tol)
+    )
+
+
 def _recover_survival_rule(art: dict) -> Tuple[bool, str]:
     ok = art.get("killpoints_survived") == art.get("killpoints_total")
     return ok, (
@@ -321,6 +352,7 @@ _EXTRA_RULES = {
     "PIPELINE": [_pipeline_order_rule],
     "ELASTIC": [_elastic_ratio_rule],
     "RECOVER": [_recover_survival_rule],
+    "LM": [_lm_tolerance_rule],
 }
 
 
