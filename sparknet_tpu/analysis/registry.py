@@ -97,6 +97,22 @@ CANONICAL_METRICS = {
     # parallelism over parallel/ring_attention.py)
     "sparknet_lm_tokens_total": (),
     "sparknet_lm_ring_hop_bytes_total": (),
+    # autoregressive generation serving (serve/generate.py KV arena +
+    # serve/batcher.py StreamBatcher + serve/fleet.py stream routing)
+    "sparknet_kv_blocks_total": (),
+    "sparknet_kv_blocks_used": (),
+    "sparknet_kv_alloc_total": (),
+    "sparknet_kv_free_total": (),
+    "sparknet_gen_streams_total": (),
+    "sparknet_gen_streams_shed_total": (),
+    "sparknet_gen_stream_errors_total": (),
+    "sparknet_gen_tokens_total": (),
+    "sparknet_gen_active_streams": (),
+    "sparknet_gen_ttft_seconds": (),
+    "sparknet_gen_intertoken_seconds": (),
+    "sparknet_gen_decode_batch_occupancy": (),
+    "sparknet_gen_jit_cache_size": (),
+    "sparknet_gen_resumes_total": (),
     # fleet collector (obs/fleet.py, --fleet_collector) — the merged
     # cross-host families on the collector's own /metrics
     "sparknet_fleet_hosts": ("state",),
@@ -123,6 +139,9 @@ CANONICAL_SPANS = {
     # the LM data plane's host-side window sampling (apps/lm_app.py —
     # nests under the producer thread's assemble span in traces)
     "data": frozenset({"sample_text"}),
+    # generation serving (serve/generate.py): the two jitted steps of
+    # the prefill/decode disaggregation
+    "gen": frozenset({"prefill", "decode_step"}),
 }
 
 # the comm-plane span triple tools/trace_report.py folds into its

@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sparknet_tpu.ops import pallas_attention
 from sparknet_tpu.ops.attention import mha_reference
 from sparknet_tpu.parallel.ring_attention import ring_attention
 
@@ -287,6 +288,100 @@ class TransformerLM:
         """Inference logits (the deploy-ish surface; sp=1 path only —
         serving a ring-sharded model would need its own mesh plumbing)."""
         return {"logits": self.forward_logits(params, batch["tokens"])}
+
+    # ------------------------------------------------------------------
+    # Generation seams (serve/generate.py) — sp=1 only: serving decodes
+    # on one chip; the ring path is a training-time construct.
+    # ------------------------------------------------------------------
+    def _mlp(self, params, i, x):
+        g2, b2 = params[f"block{i}_ln2"]
+        w1, c1, w2, c2 = params[f"block{i}_mlp"]
+        h = _layer_norm(x, g2, b2)
+        return x + (jax.nn.gelu(h @ w1 + c1) @ w2 + c2)
+
+    def prefill_with_kv(self, params, tokens):
+        """Causal prefill that ALSO returns every layer's K/V.
+
+        ``tokens`` is (B, T) with T <= seq_len (a prefill length bucket,
+        pad rows at the END — causality keeps the valid prefix exact).
+        Returns ``(logits (B,T,V), k (depth,B,T,H,D), v (same))``.
+        Prefill attention rides the Pallas flash kernel where it lowers
+        natively, the dense reference elsewhere."""
+        if self.sp_size > 1:
+            raise ValueError("generation serves the sp=1 dense model only")
+        tokens = tokens.astype(jnp.int32)
+        B, T = tokens.shape
+        if T > self.seq_len:
+            raise ValueError(f"prefill T={T} exceeds seq_len={self.seq_len}")
+        H, D = self.heads, self.head_dim
+        tok_table, pos_table = params["embed"]
+        x = (
+            jnp.take(tok_table, tokens, axis=0) + pos_table[:T][None]
+        ).astype(jnp.float32)
+        ks, vs = [], []
+        for i in range(self.depth):
+            g1, b1 = params[f"block{i}_ln1"]
+            h = _layer_norm(x, g1, b1)
+            wq, wk, wv, wo = params[f"block{i}_attn"]
+            q = (h @ wq).reshape(B, T, H, D)
+            k = (h @ wk).reshape(B, T, H, D)
+            v = (h @ wv).reshape(B, T, H, D)
+            ks.append(k)
+            vs.append(v)
+            if pallas_attention.lowerable():
+                out = pallas_attention.flash_attention(q, k, v, causal=True)
+            else:
+                out = mha_reference(q, k, v, causal=True)
+            x = x + out.reshape(B, T, self.dim) @ wo
+            x = self._mlp(params, i, x)
+        gf, bf = params["ln_f"]
+        (wh,) = params["head"]
+        return _layer_norm(x, gf, bf) @ wh, jnp.stack(ks), jnp.stack(vs)
+
+    def decode_step_with_kv(self, params, tokens, positions, k_ctx, v_ctx):
+        """One decode position per sequence against gathered KV context.
+
+        ``tokens`` (B,) — the token to embed at ``positions`` (B,) (=
+        the number of already-cached positions per sequence); ``k_ctx``/
+        ``v_ctx`` (depth, B, S, H, D) — the paged-cache gather, rows at
+        index >= positions[b] are garbage and masked off.  This step's
+        own K/V are written into the context copy (so attention sees
+        them) AND returned as ``new_k``/``new_v`` (depth, B, H, D) for
+        the engine to scatter into the arena.  Returns
+        ``(logits (B,V), new_k, new_v)``."""
+        if self.sp_size > 1:
+            raise ValueError("generation serves the sp=1 dense model only")
+        tokens = tokens.astype(jnp.int32)
+        positions = positions.astype(jnp.int32)
+        B = tokens.shape[0]
+        H, D = self.heads, self.head_dim
+        tok_table, pos_table = params["embed"]
+        x = (
+            jnp.take(tok_table, tokens, axis=0)
+            + jnp.take(pos_table, positions, axis=0)
+        )[:, None, :].astype(jnp.float32)
+        new_ks, new_vs = [], []
+        rows = jnp.arange(B)
+        for i in range(self.depth):
+            g1, b1 = params[f"block{i}_ln1"]
+            h = _layer_norm(x, g1, b1)
+            wq, wk, wv, wo = params[f"block{i}_attn"]
+            q = (h @ wq).reshape(B, 1, H, D)
+            k1 = (h @ wk).reshape(B, H, D)
+            v1 = (h @ wv).reshape(B, H, D)
+            new_ks.append(k1)
+            new_vs.append(v1)
+            kc = k_ctx[i].at[rows, positions].set(k1)
+            vc = v_ctx[i].at[rows, positions].set(v1)
+            out = pallas_attention.decode_attention(
+                q, kc, vc, lengths=positions + 1
+            )
+            x = x + out.reshape(B, 1, self.dim) @ wo
+            x = self._mlp(params, i, x)
+        gf, bf = params["ln_f"]
+        (wh,) = params["head"]
+        logits = (_layer_norm(x, gf, bf) @ wh)[:, 0]
+        return logits, jnp.stack(new_ks), jnp.stack(new_vs)
 
     # ------------------------------------------------------------------
     def with_sp(self, sp_axis: Optional[str], sp_size: int) -> "TransformerLM":

@@ -54,6 +54,30 @@ class JsonHTTPHandler(BaseHTTPRequestHandler):
             extra_headers,
         )
 
+    # ------------------------------------------------------------------
+    # Chunked transfer (HTTP/1.1) — the token-streaming send path
+    # (serve/server.py POST /generate).  Content-Length framing cannot
+    # stream an unknown-length body over keep-alive; chunked framing
+    # can, and the 0-length terminal chunk keeps the connection clean.
+    def _send_chunked_start(self, code: int, ctype: str,
+                            extra_headers=()) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Transfer-Encoding", "chunked")
+        for k, v in extra_headers:
+            self.send_header(k, v)
+        self.end_headers()
+
+    def _send_chunk(self, payload: bytes) -> None:
+        if not payload:
+            return  # an empty chunk would terminate the stream
+        self.wfile.write(b"%X\r\n" % len(payload) + payload + b"\r\n")
+        self.wfile.flush()
+
+    def _end_chunks(self) -> None:
+        self.wfile.write(b"0\r\n\r\n")
+        self.wfile.flush()
+
 
 class _ObsHandler(JsonHTTPHandler):
     exporter: "ObsExporter"  # bound per-server via the factory below

@@ -41,6 +41,15 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, tq, tk):
     o_ref[0] = (o / jnp.sum(p, axis=-1, keepdims=True)).astype(o_ref.dtype)
 
 
+def lowerable() -> bool:
+    """True when the Pallas kernels lower natively on this backend.
+    The serving decode path (``serve/generate.py``) gates on this: TPU
+    takes the kernel, everything else takes the dense reference —
+    interpreter mode stays a test-only tool (it is far slower than the
+    XLA-compiled reference on CPU)."""
+    return jax.default_backend() in ("tpu",)
+
+
 def flash_attention(
     q, k, v, causal: bool = False, block_q: int = 128, interpret=None
 ):
@@ -75,3 +84,89 @@ def flash_attention(
         interpret=interpret,
     )(qf, kf, vf)
     return jnp.transpose(out.reshape(b, h, tq, d), (0, 2, 1, 3))
+
+
+# ----------------------------------------------------------------------
+# Decode attention: q_len == 1 over a (possibly over-allocated) context
+# ----------------------------------------------------------------------
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, scale, s):
+    q = q_ref[0]  # (1, d)
+    k = k_ref[0]  # (s, d)
+    v = v_ref[0]
+    n = len_ref[0, 0]
+    scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (1, s), 1)
+    scores = jnp.where(k_pos < n, scores, -jnp.inf)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    p = jnp.exp(scores - m)
+    o = jnp.dot(p, v, preferred_element_type=jnp.float32)
+    o_ref[0] = (o / jnp.sum(p, axis=-1, keepdims=True)).astype(o_ref.dtype)
+
+
+def _decode_reference(q, k, v, lengths=None):
+    """Dense masked decode attention — the non-TPU fallback and the
+    correctness pin for the kernel path.  Shapes as
+    ``decode_attention``."""
+    b, _, h, d = q.shape
+    s = k.shape[1]
+    scale = 1.0 / math.sqrt(d)
+
+    def bhtd(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).astype(jnp.float32)
+
+    scores = jnp.einsum("bhqd,bhkd->bhqk", bhtd(q), bhtd(k)) * scale
+    if lengths is not None:
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (b, h, 1, s), 3)
+        scores = jnp.where(
+            k_pos < lengths.astype(jnp.int32)[:, None, None, None],
+            scores,
+            -jnp.inf,
+        )
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, bhtd(v))
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def decode_attention(q, k, v, lengths=None, interpret=None):
+    """Single-position attention for autoregressive decode.
+
+    ``q`` is (B, 1, H, D) — the one new position per sequence; ``k``/``v``
+    are (B, S, H, D) gathered context where only the first ``lengths[b]``
+    rows of sequence b are valid (the paged-KV gather over-allocates to
+    the static S).  ``lengths`` None means the whole context is valid.
+
+    Routing: the Pallas kernel where it lowers natively
+    (``lowerable()``, i.e. TPU), the dense masked reference elsewhere;
+    ``interpret=True`` forces the kernel in interpreter mode so CPU
+    tests can pin the kernel itself against the reference."""
+    b, tq, h, d = q.shape
+    if tq != 1:
+        raise ValueError(f"decode_attention wants q_len=1, got {tq}")
+    s = k.shape[1]
+    if not (lowerable() or interpret):
+        return _decode_reference(q, k, v, lengths)
+    if lengths is None:
+        lengths = jnp.full((b,), s, jnp.int32)
+    scale = 1.0 / math.sqrt(d)
+
+    def flat(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, x.shape[1], d)
+
+    # one grid cell per (batch*head); the sequence length rides in as a
+    # per-cell scalar block so the mask is computed on the VPU in-cell
+    len_bh = jnp.repeat(lengths.astype(jnp.int32), h).reshape(b * h, 1)
+    kernel = partial(_decode_kernel, scale=scale, s=s)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h,),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, d), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, 1, d), q.dtype),
+        interpret=True if interpret else (not lowerable()),
+    )(len_bh, flat(q), flat(k), flat(v))
+    return jnp.transpose(out.reshape(b, h, 1, d), (0, 2, 1, 3))

@@ -47,6 +47,14 @@ real TPU pod into a small cifar10_quick run on the virtual mesh —
   unchanged); the delivery watcher (``serve/delivery.py``) must
   REJECT it at CRC verify — it must never reach a canary — and
   quarantine the publish ``*.corrupt``.
+- **decode replica kill**: a replica of a GENERATION fleet
+  (``serve/generate.py`` engines under continuous batching) is
+  hard-killed MID-STREAM, with a client half-way through its tokens;
+  the router must eject it and RESUME the stream on a sibling via
+  re-prefill of prompt + tokens-so-far — greedy decode is
+  deterministic, so the full token sequence must be IDENTICAL to an
+  undisturbed run — or end with a clean error event, never a hung
+  connection.
 
 Every fault is counted as injected and (when the run recovers) survived;
 ``bench.py --mode=chaos`` emits the ``CHAOS_r07.json`` artifact
@@ -155,6 +163,16 @@ class FaultPlan:
     # AFTER the preemption: the fleet is rebuilt lazily on the resumed
     # process, and the fire-once guard keeps a replay from re-killing.
     replica_death_round: Optional[int] = 4
+    # decode_replica_kill: at the END of this round a 2-replica
+    # GENERATION fleet (tiny TransformerLM under StreamBatcher
+    # continuous batching) loses the replica serving an in-flight
+    # token stream to a hard kill.  Survived = the router ejects the
+    # dead replica, RESUMES the stream on the sibling by re-prefilling
+    # prompt + tokens-so-far, and the client's final token sequence is
+    # IDENTICAL to an undisturbed run (greedy decode is deterministic)
+    # — plus respawn returns the dead replica to rotation and a fresh
+    # stream serves end-to-end afterwards.  Never a hung connection.
+    decode_replica_kill_round: Optional[int] = 4
     # published_snapshot_corrupt: at the END of this round the current
     # training state is PUBLISHED for delivery (passing verdict
     # attached) and its model bytes are then flipped on disk (size
@@ -216,6 +234,7 @@ class FaultPlan:
             cache_cold_round=None,
             collector_outage_round=None,
             replica_death_round=None,
+            decode_replica_kill_round=None,
             publish_corrupt_round=None,
             slice_preempt_round=None,
             driver_kill_round=None,
@@ -415,14 +434,17 @@ layer { name: "prob" type: "Softmax" bottom: "logits" top: "prob" }
 
 
 class _ServeFaults:
-    """The serving-fleet faults: ``replica_death`` and
-    ``published_snapshot_corrupt``, run as bounded sub-scenarios at
-    seeded round boundaries (fire once, by absolute round — a
-    post-resume replay can't re-fire them).  The fleet is a real
-    ``serve/fleet.py`` pool (2 replicas, toy net) built lazily on
-    first use; the corrupt-publish leg publishes the ACTUAL training
-    state of the chaos run through ``serve/publish.py`` and corrupts
-    the published model bytes."""
+    """The serving-fleet faults: ``replica_death``,
+    ``published_snapshot_corrupt`` and ``decode_replica_kill``, run as
+    bounded sub-scenarios at seeded round boundaries (fire once, by
+    absolute round — a post-resume replay can't re-fire them).  The
+    fleet is a real ``serve/fleet.py`` pool (2 replicas, toy net)
+    built lazily on first use; the corrupt-publish leg publishes the
+    ACTUAL training state of the chaos run through ``serve/publish.py``
+    and corrupts the published model bytes; the decode-kill leg runs a
+    SEPARATE 2-replica generation fleet (tiny TransformerLM under
+    continuous batching) and kills the replica serving a live token
+    stream."""
 
     def __init__(self, plan: FaultPlan, counters: Dict, note, workdir: str):
         self.plan = plan
@@ -431,8 +453,11 @@ class _ServeFaults:
         self.workdir = workdir
         self._death_at = plan.replica_death_round
         self._corrupt_at = plan.publish_corrupt_round
+        self._decode_kill_at = plan.decode_replica_kill_round
         self._pool = None
         self._router = None
+        self._gen_pool = None
+        self._gen_router = None
         self._x = np.random.RandomState(plan.seed).randn(
             1, 3, 8, 8
         ).astype(np.float32)
@@ -455,10 +480,35 @@ class _ServeFaults:
             self._router = Router(self._pool, max_inflight=16)
         return self._pool, self._router
 
+    def _gen_fleet(self):
+        if self._gen_pool is None:
+            from sparknet_tpu.models.transformer_lm import TransformerLM
+            from sparknet_tpu.serve import ReplicaPool, Router
+            from sparknet_tpu.serve.generate import GenerationEngine
+
+            def make_engine(weights=None):
+                lm = TransformerLM(
+                    dim=32, depth=2, heads=2, seq_len=64, vocab=64
+                )
+                return GenerationEngine(
+                    lm, weights=weights, prefill_buckets=(16, 64),
+                    max_streams=4, kv_blocks=48, kv_block_size=8,
+                    seed=self.plan.seed,
+                )
+
+            self._gen_pool = ReplicaPool(
+                make_engine, replicas=2, max_queue=16, stream=True
+            )
+            self._gen_router = Router(self._gen_pool, max_inflight=16)
+        return self._gen_pool, self._gen_router
+
     def on_round_end(self, r: int, solver, host_state_fn) -> None:
         if self._death_at is not None and r == self._death_at:
             self._death_at = None
             self._replica_death(r)
+        if self._decode_kill_at is not None and r == self._decode_kill_at:
+            self._decode_kill_at = None
+            self._decode_replica_kill(r)
         if self._corrupt_at is not None and r == self._corrupt_at:
             self._corrupt_at = None
             self._publish_corrupt(r, solver, host_state_fn)
@@ -486,6 +536,59 @@ class _ServeFaults:
                 "replica rejoined rotation"
             )
             _obs.instant("recovered", kind="replica_death", round=r)
+
+    def _decode_replica_kill(self, r: int) -> None:
+        pool, router = self._gen_fleet()
+        prompt = [5, 9, 2, 7]
+        max_new = 40
+        # greedy decode is deterministic: an undisturbed run on either
+        # replica (identical seeded weights) is the expected sequence
+        expect = list(router.submit_stream(prompt, max_new))[-1]
+        self.counters["decode_kill_injected"] = 1
+        _obs.fault("decode_replica_kill", round=r)
+        gen = router.submit_stream(prompt, max_new, timeout=30.0)
+        first = next(gen)  # stream admitted + first token delivered
+        victim = None
+        for rep in pool.replicas:
+            if rep.batcher.active_count() > 0:
+                victim = rep
+                break
+        self.note(
+            f"round {r}: generation replica "
+            f"{victim.index if victim else '?'} hard-killed with a "
+            "token stream in flight"
+        )
+        if victim is not None:
+            victim.kill()
+        events = [first] + list(gen)  # bounded by timeout: never hangs
+        final = events[-1]
+        ejected = (
+            victim is not None and victim.state == "ejected"
+        )
+        if victim is not None and ejected:
+            pool.respawn(victim.index)
+        # respawn REPLACES the Replica object — re-read from the pool
+        rejoined = (
+            victim is not None
+            and pool.replicas[victim.index].state == "live"
+        )
+        after = list(router.submit_stream(prompt, max_new))[-1]
+        if (
+            expect["event"] == "done"
+            and final["event"] == "done"
+            and final["tokens"] == expect["tokens"]
+            and ejected
+            and rejoined
+            and after["event"] == "done"
+            and after["tokens"] == expect["tokens"]
+        ):
+            self.counters["decode_kill_survived"] = 1
+            self.note(
+                f"round {r}: stream resumed on the sibling via "
+                "re-prefill — token sequence IDENTICAL to the "
+                "undisturbed run, dead replica respawned into rotation"
+            )
+            _obs.instant("recovered", kind="decode_replica_kill", round=r)
 
     def _publish_corrupt(self, r: int, solver, host_state_fn) -> None:
         from sparknet_tpu.serve import DeliveryController
@@ -535,6 +638,10 @@ class _ServeFaults:
             self._router.close()
             self._router = None
             self._pool = None
+        if self._gen_router is not None:
+            self._gen_router.close()
+            self._gen_router = None
+            self._gen_pool = None
 
 
 def _driver_kill_scenario(plan: FaultPlan, counters: Dict, note, workdir):
@@ -1426,10 +1533,12 @@ def run_chaos(
     outage = None
     if plan.collector_outage_round is not None:
         outage = _CollectorOutage(plan, counters, note)
-    # the serving-fleet faults (replica_death, published_snapshot_corrupt)
+    # the serving-fleet faults (replica_death, decode_replica_kill,
+    # published_snapshot_corrupt)
     serve_faults = None
     if (
         plan.replica_death_round is not None
+        or plan.decode_replica_kill_round is not None
         or plan.publish_corrupt_round is not None
     ):
         serve_faults = _ServeFaults(plan, counters, note, workdir)
@@ -1606,6 +1715,9 @@ def run_chaos(
         "replica_death": (
             "replica_death_injected", "replica_death_survived",
         ),
+        "decode_replica_kill": (
+            "decode_kill_injected", "decode_kill_survived",
+        ),
         "published_snapshot_corrupt": (
             "publish_corrupt_injected", "publish_corrupt_survived",
         ),
@@ -1647,6 +1759,7 @@ def run_chaos(
         "collector_outage_round": plan.collector_outage_round,
         "collector_outage": outage.summary if outage is not None else None,
         "replica_death_round": plan.replica_death_round,
+        "decode_replica_kill_round": plan.decode_replica_kill_round,
         "publish_corrupt_round": plan.publish_corrupt_round,
         "driver_kill_round": plan.driver_kill_round,
         "driver_kill": counters.get("driver_kill_summary"),

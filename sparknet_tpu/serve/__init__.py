@@ -20,7 +20,16 @@ publish -> verify -> canary -> promote/rollback delivery loop.
   trainer publishes sentry-verified snapshots (CRC manifest + health
   verdict), the delivery watcher CRC-verifies, warms a standby engine
   off-path, canaries live traffic, and promotes or rolls back.
-- ``server.ServeServer``      — stdlib-only HTTP front-end: ``/predict``,
+- ``generate.GenerationEngine``/``kv_cache.KVBlockPool`` — autoregressive
+  LM serving: prefill/decode-disaggregated jitted steps over a paged
+  KV-cache arena (block tables, worst-case admission, exact
+  alloc==free accounting), greedy token streaming.
+- ``batcher.StreamBatcher``   — iteration-level continuous batching for
+  generation: streams join the running decode batch the moment a slot
+  and KV budget exist and leave the moment they finish, no generation
+  barrier; per-stream NDJSON event queues (TTFT/inter-token histograms).
+- ``server.ServeServer``      — stdlib-only HTTP front-end: ``/predict``
+  (or ``/generate`` chunked-NDJSON token streaming in generation mode),
   ``/healthz`` (per-replica state + delivery phase), ``/metrics``; 429
   load-shedding and graceful drain on SIGTERM (``utils/signals.py``).
 
@@ -34,9 +43,19 @@ from sparknet_tpu.obs.metrics import (  # noqa: F401
     Histogram,
     MetricsRegistry,
 )
-from sparknet_tpu.serve.batcher import MicroBatcher, QueueFull  # noqa: F401
+from sparknet_tpu.serve.batcher import (  # noqa: F401
+    GenStream,
+    MicroBatcher,
+    QueueFull,
+    StreamBatcher,
+)
 from sparknet_tpu.serve.delivery import DeliveryController  # noqa: F401
 from sparknet_tpu.serve.engine import InferenceEngine  # noqa: F401
+from sparknet_tpu.serve.generate import GenerationEngine  # noqa: F401
+from sparknet_tpu.serve.kv_cache import (  # noqa: F401
+    KVBlockPool,
+    KVBudgetExceeded,
+)
 from sparknet_tpu.serve.fleet import (  # noqa: F401
     FleetUnservable,
     Replica,

@@ -51,7 +51,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from sparknet_tpu.obs.metrics import MetricsRegistry
-from sparknet_tpu.serve.batcher import MicroBatcher, QueueFull
+from sparknet_tpu.serve.batcher import MicroBatcher, QueueFull, StreamBatcher
 from sparknet_tpu.serve.engine import InferenceEngine
 
 # replica states (the /healthz vocabulary)
@@ -77,13 +77,23 @@ class Replica:
         engine: InferenceEngine,
         max_queue: int = 256,
         max_wait_ms: float = 2.0,
+        stream: bool = False,
     ):
         self.index = index
         self.state = LIVE
         self.max_queue = int(max_queue)
         self.max_wait_ms = float(max_wait_ms)
-        self.batcher = MicroBatcher(
-            engine, max_queue=max_queue, max_wait_ms=max_wait_ms
+        self.stream = bool(stream)
+        # stream replicas run continuous batching over a GenerationEngine
+        # (serve/generate.py); everything the pool/router touch —
+        # queue_depth, drain, stop, _running/_worker, engine attribute —
+        # is the shared batcher surface, so the fleet contracts compose
+        self.batcher = (
+            StreamBatcher(engine, max_queue=max_queue)
+            if self.stream
+            else MicroBatcher(
+                engine, max_queue=max_queue, max_wait_ms=max_wait_ms
+            )
         )
 
     @property
@@ -126,11 +136,17 @@ class _CanaryRound:
     every observation lands here under one lock."""
 
     def __init__(self, engine: InferenceEngine, publish_id: str,
-                 max_wait_ms: float = 2.0):
+                 max_wait_ms: float = 2.0, stream: bool = False):
         self.engine = engine
         self.publish_id = publish_id
-        self.batcher = MicroBatcher(
-            engine, max_queue=64, max_wait_ms=max_wait_ms
+        self.stream = bool(stream)
+        # a generation canary is scored, not batch-served: the mirror
+        # thread teacher-forces the incumbent's tokens through
+        # ``engine.score_tokens`` directly, so no batcher exists
+        self.batcher = (
+            None
+            if self.stream
+            else MicroBatcher(engine, max_queue=64, max_wait_ms=max_wait_ms)
         )
         self._lock = threading.Lock()
         self.mirrored = 0
@@ -183,7 +199,8 @@ class _CanaryRound:
             }
 
     def close(self) -> None:
-        self.batcher.stop(drain=False, timeout=5.0)
+        if self.batcher is not None:
+            self.batcher.stop(drain=False, timeout=5.0)
 
 
 class ReplicaPool:
@@ -206,6 +223,7 @@ class ReplicaPool:
         max_wait_ms: float = 2.0,
         registry: Optional[MetricsRegistry] = None,
         devices: Optional[Sequence] = None,
+        stream: bool = False,
     ):
         if replicas < 1:
             raise ValueError(f"need >= 1 replica, got {replicas}")
@@ -213,6 +231,10 @@ class ReplicaPool:
         self.devices = list(devices) if devices else None
         self.max_queue = int(max_queue)
         self.max_wait_ms = float(max_wait_ms)
+        # stream=True: the factory builds GenerationEngines and every
+        # replica runs a StreamBatcher (continuous batching) — the
+        # eject/respawn/hot-swap/canary contracts compose unchanged
+        self.stream = bool(stream)
         self.registry = registry if registry is not None else MetricsRegistry()
         self._lock = threading.Lock()
         self.incumbent_id: Optional[str] = None
@@ -291,6 +313,7 @@ class ReplicaPool:
             self._new_engine(index, weights=weights),
             max_queue=self.max_queue,
             max_wait_ms=self.max_wait_ms,
+            stream=self.stream,
         )
         self._set_state(rep, LIVE)
         return rep
@@ -350,6 +373,7 @@ class ReplicaPool:
                 self._new_engine(index, weights=self._incumbent_weights),
                 max_queue=self.max_queue,
                 max_wait_ms=self.max_wait_ms,
+                stream=self.stream,
             )
             self.replicas[index] = rep
         old.stop(drain=False, timeout=1.0)
@@ -451,6 +475,12 @@ class Router:
             "sparknet_delivery_canary_mirrors_total",
             "requests mirrored to the canary engine during a decision "
             "window (the client is always answered by an incumbent)",
+        )
+        self.m_resumes = reg.counter(
+            "sparknet_gen_resumes_total",
+            "streams resumed on a sibling replica via re-prefill after "
+            "a mid-stream replica death (greedy decode is deterministic "
+            "— the continuation is exact)",
         )
 
     # ------------------------------------------------------------------
@@ -572,6 +602,140 @@ class Router:
         )
 
     # ------------------------------------------------------------------
+    # streaming generation (stream=True pools)
+    def submit_stream(self, prompt, max_new: int, timeout: float = 120.0):
+        """Route one generation stream; yields token events and exactly
+        one terminal event (``done``/``stopped``/``error``).
+
+        Same contracts as ``submit``, extended to streams: fleet-wide
+        bounded admission (``QueueFull`` -> 429 raised before the first
+        event), min-in-flight pick, and — the stream-specific one —
+        RESUME on a mid-stream replica death: the dead replica is
+        ejected and the stream re-prefills prompt + tokens-so-far on a
+        sibling.  Greedy decode is deterministic, so the sibling
+        continues the IDENTICAL sequence; token indices keep counting
+        and the client never sees the seam (``decode_replica_kill``
+        chaos fault).  Finished streams canary-mirror every k-th via
+        per-token logprob scoring."""
+        self._admit()
+        t0 = time.perf_counter()
+        try:
+            prompt = [int(t) for t in prompt]
+            max_new = int(max_new)
+            tokens: List[int] = []
+            logprobs: List[float] = []
+            attempts = 0
+            while True:
+                if tokens and len(tokens) >= max_new:
+                    # the kill landed between the last token and its
+                    # done event — nothing left to decode; finish here
+                    yield {
+                        "event": "done",
+                        "tokens": list(tokens),
+                        "text": StreamBatcher._text(tokens),
+                        "finish_reason": "length",
+                    }
+                    return
+                rep = self._pick()
+                with self._lock:
+                    self._inflight[rep.index] = (
+                        self._inflight.get(rep.index, 0) + 1
+                    )
+                    self.m_inflight_set(rep.index)
+                err = None
+                try:
+                    try:
+                        st = rep.batcher.submit_stream(
+                            prompt + tokens, max_new - len(tokens)
+                        )
+                    except QueueFull:
+                        self.m_shed.inc()
+                        raise
+                    except ValueError:
+                        # bad geometry: a FRESH stream propagates (400
+                        # upstream); a resume that outgrew the bucket
+                        # ends with a clean error event instead
+                        if not tokens:
+                            raise
+                        err = "resume exceeds engine geometry"
+                    except (RuntimeError, OSError) as e:
+                        # replica refused outright (stopped batcher) —
+                        # the eject-and-retry path below
+                        err = f"submit failed: {e}"
+                    if err is None:
+                        base = len(tokens)
+                        for ev in st.iter_events(timeout=timeout):
+                            kind = ev["event"]
+                            if kind == "token":
+                                tokens.append(int(ev["token"]))
+                                logprobs.append(float(ev["logprob"]))
+                                yield {
+                                    "event": "token",
+                                    "token": tokens[-1],
+                                    "logprob": logprobs[-1],
+                                    "index": base + int(ev["index"]),
+                                }
+                            elif kind == "done":
+                                self.pool.m_requests.labels(
+                                    str(rep.index)
+                                ).inc()
+                                self.m_requests.inc()
+                                lat = time.perf_counter() - t0
+                                self.m_latency.observe(lat)
+                                self._maybe_mirror_stream(
+                                    prompt, tokens, logprobs, lat
+                                )
+                                yield {
+                                    "event": "done",
+                                    "tokens": list(tokens),
+                                    "text": StreamBatcher._text(tokens),
+                                    "finish_reason": ev.get(
+                                        "finish_reason", "length"
+                                    ),
+                                }
+                                return
+                            elif kind == "stopped":
+                                yield {
+                                    "event": "stopped",
+                                    "tokens": list(tokens),
+                                    "text": StreamBatcher._text(tokens),
+                                    "finish_reason": "stopped",
+                                }
+                                return
+                            else:  # error — maybe resumable
+                                err = ev.get("error", "stream failed")
+                                break
+                finally:
+                    with self._lock:
+                        self._inflight[rep.index] = max(
+                            0, self._inflight.get(rep.index, 0) - 1
+                        )
+                        self.m_inflight_set(rep.index)
+                # error leg: eject a dead replica and resume on a
+                # sibling, or end with a clean error event — NEVER a
+                # silent hang
+                self.pool.m_errors.labels(str(rep.index)).inc()
+                if rep.healthy:
+                    yield {"event": "error", "error": err}
+                    return
+                self.pool.eject(rep.index)
+                attempts += 1
+                self.m_retries.inc()
+                if attempts > len(self.pool.replicas):
+                    yield {
+                        "event": "error",
+                        "error": (
+                            f"stream failed on {attempts} replicas: {err}"
+                        ),
+                    }
+                    return
+                if tokens:
+                    self.m_resumes.inc()
+        finally:
+            with self._lock:
+                self._total_inflight -= 1
+
+    # ------------------------------------------------------------------
     # canary plumbing (driven by serve/delivery.py)
     def install_canary(self, engine: InferenceEngine,
                        publish_id: str) -> _CanaryRound:
@@ -583,7 +747,10 @@ class Router:
                 f"canary {self._canary.publish_id!r} already installed"
             )
         round_ = _CanaryRound(
-            engine, publish_id, max_wait_ms=self.pool.max_wait_ms
+            engine,
+            publish_id,
+            max_wait_ms=self.pool.max_wait_ms,
+            stream=getattr(self.pool, "stream", False),
         )
         self._canary = round_
         self._mirror_thread = threading.Thread(
@@ -620,8 +787,32 @@ class Router:
         if not take:
             return
         try:
-            self._mirror_q.put_nowait((round_, x, incumbent_out,
+            self._mirror_q.put_nowait(("predict", round_, x, incumbent_out,
                                        incumbent_s))
+        except queue.Full:
+            with self._lock:
+                self._mirror_dropped += 1
+
+    def _maybe_mirror_stream(self, prompt, tokens, logprobs,
+                             incumbent_s: float) -> None:
+        """Every k-th FINISHED stream mirrors to a generation canary:
+        the incumbent's tokens are teacher-force scored on the canary
+        and the divergence is the max per-token |delta logprob| —
+        token-level disagreement shows up as a large logprob delta at
+        the first divergent position."""
+        round_ = self._canary
+        if round_ is None or not self._canary_every or not tokens:
+            return
+        with self._lock:
+            self._submitted += 1
+            take = (self._submitted % self._canary_every) == 0
+        if not take:
+            return
+        try:
+            self._mirror_q.put_nowait((
+                "stream", round_, list(prompt), list(tokens),
+                np.asarray(logprobs, np.float64), incumbent_s,
+            ))
         except queue.Full:
             with self._lock:
                 self._mirror_dropped += 1
@@ -635,27 +826,45 @@ class Router:
             item = self._mirror_q.get()
             if item is None:
                 return
-            round_, x, incumbent_out, incumbent_s = item
+            kind, round_ = item[0], item[1]
             if round_ is not self._canary:
                 continue  # a stale mirror from a cleared round
             t0 = time.perf_counter()
             error = nonfinite = False
             divergence = None
+            incumbent_s = item[-1]
             try:
-                out = round_.batcher.submit(x, timeout=60.0)
-                # both sides are host numpy arrays (serving responses
-                # are materialized by contract); the reductions below
-                # never touch a device buffer
-                # sparknet: sync-ok(host numpy divergence reduction over already-materialized serving outputs)
-                delta = float(np.max(np.abs(
-                    out.astype(np.float64)
-                    - incumbent_out.astype(np.float64)
-                )))
-                if not np.isfinite(out).all():
-                    nonfinite = True
-                    divergence = float("inf")
+                if kind == "stream":
+                    # generation canary: teacher-force the incumbent's
+                    # tokens through the canary engine and compare
+                    # per-token logprobs — deterministic, no sampling
+                    _, _, prompt, toks, inc_lps, incumbent_s = item
+                    lps = round_.engine.score_tokens(prompt, toks)
+                    # sparknet: sync-ok(host numpy divergence reduction over already-materialized logprobs)
+                    if not np.isfinite(lps).all():
+                        nonfinite = True
+                        divergence = float("inf")
+                    else:
+                        # sparknet: sync-ok(host numpy divergence reduction over already-materialized logprobs)
+                        divergence = float(np.max(np.abs(
+                            lps.astype(np.float64) - inc_lps
+                        )))
                 else:
-                    divergence = delta
+                    _, _, x, incumbent_out, incumbent_s = item
+                    out = round_.batcher.submit(x, timeout=60.0)
+                    # both sides are host numpy arrays (serving
+                    # responses are materialized by contract); the
+                    # reductions below never touch a device buffer
+                    # sparknet: sync-ok(host numpy divergence reduction over already-materialized serving outputs)
+                    delta = float(np.max(np.abs(
+                        out.astype(np.float64)
+                        - incumbent_out.astype(np.float64)
+                    )))
+                    if not np.isfinite(out).all():
+                        nonfinite = True
+                        divergence = float("inf")
+                    else:
+                        divergence = delta
             except Exception:
                 error = True
             round_.note(

@@ -12,6 +12,16 @@ Endpoints:
                   429 when admission sheds (fleet-wide bound in fleet
                   mode), 503 while draining or when NO live replica
                   remains, 400 on malformed input.
+  POST /generate  (generation mode only: a ``GenerationEngine`` or a
+                  stream-mode fleet) body {"prompt": [token ids],
+                  "max_new": n} -> chunked NDJSON, one event per line:
+                  {"event": "token", ...} per decoded token, then a
+                  terminal {"event": "done" | "stopped" | "error"}.
+                  Admission errors (429/503/400/504) are sent as plain
+                  JSON BEFORE any chunk — the status line is only
+                  committed once the first token exists.  A drain
+                  deadline ends live streams with "stopped" (partial
+                  tokens included), never a dead connection.
   GET  /healthz   single engine: {"status": "ok"} | 503 draining.
                   fleet: per-replica state rows (live/draining/ejected)
                   + the delivery phase block (incumbent, canary,
@@ -41,7 +51,11 @@ from typing import Optional
 import numpy as np
 
 from sparknet_tpu.obs.exporter import JsonHTTPHandler
-from sparknet_tpu.serve.batcher import MicroBatcher, QueueFull
+from sparknet_tpu.serve.batcher import (
+    MicroBatcher,
+    QueueFull,
+    StreamBatcher,
+)
 from sparknet_tpu.serve.engine import InferenceEngine
 from sparknet_tpu.serve.fleet import FleetUnservable, Router
 from sparknet_tpu.utils.signals import SignalHandler, SolverAction
@@ -94,14 +108,31 @@ class _Handler(JsonHTTPHandler):
         except ValueError:
             length = 0
         raw = self.rfile.read(length) if length > 0 else b""
-        if self.path != "/predict":
+        # each server speaks exactly one inference dialect: /predict on
+        # classifier engines, /generate on generation engines — the
+        # other route 404s with a pointer instead of half-working
+        if self.path == "/predict" and not ctx.gen_mode:
+            handler = self._predict
+        elif self.path == "/generate" and ctx.gen_mode:
+            handler = self._generate
+        elif self.path == "/predict" and ctx.gen_mode:
+            self._send_json(
+                404, {"error": "generation server — use POST /generate"}
+            )
+            return
+        elif self.path == "/generate" and not ctx.gen_mode:
+            self._send_json(
+                404, {"error": "prediction server — use POST /predict"}
+            )
+            return
+        else:
             self._send_json(404, {"error": f"no route {self.path}"})
             return
         # the open-request gauge covers the full front-end residency of
-        # a /predict (parse + queue wait + inference + serialize)
+        # a request (parse + queue wait + inference + serialize)
         ctx.m_open_requests.inc()
         try:
-            self._predict(ctx, raw)
+            handler(ctx, raw)
         finally:
             ctx.m_open_requests.dec()
 
@@ -175,6 +206,87 @@ class _Handler(JsonHTTPHandler):
             },
         )
 
+    # ------------------------------------------------------------------
+    def _generate(self, ctx: "ServeServer", raw: bytes) -> None:
+        if ctx.draining:
+            self._send_json(
+                503, {"status": "draining"}, extra_headers=_RETRY
+            )
+            return
+        try:
+            body = json.loads(raw or b"{}")
+            prompt = [int(t) for t in body["prompt"]]
+            max_new = int(body.get("max_new", 16))
+        except (ValueError, KeyError, TypeError) as e:
+            self._send_json(400, {"error": f"bad request body: {e}"})
+            return
+        if not prompt or max_new < 1:
+            self._send_json(
+                400, {"error": "need a non-empty prompt and max_new >= 1"}
+            )
+            return
+        # Pull the FIRST event before committing the status line: every
+        # admission failure (shed, unservable fleet, bad geometry) still
+        # maps to a clean JSON status this way.  After the first token
+        # the response is chunked NDJSON and errors become error events.
+        try:
+            events = ctx.submit_stream(prompt, max_new)
+            first = next(events)
+        except QueueFull:
+            self._send_json(
+                429,
+                {"error": "queue or KV budget full, retry later"},
+                extra_headers=_RETRY,
+            )
+            return
+        except FleetUnservable as e:
+            self._send_json(
+                503, {"status": "unservable", "error": str(e)},
+                extra_headers=_RETRY,
+            )
+            return
+        except ValueError as e:  # prompt/max_new vs engine geometry
+            self._send_json(400, {"error": str(e)})
+            return
+        except TimeoutError as e:
+            self._send_json(504, {"error": str(e)})
+            return
+        except StopIteration:
+            self._send_json(500, {"error": "stream produced no events"})
+            return
+        except RuntimeError as e:
+            if ctx.draining:
+                self._send_json(
+                    503, {"status": "draining"}, extra_headers=_RETRY
+                )
+            else:
+                self._send_json(500, {"error": f"generation failed: {e}"})
+            return
+        except Exception as e:  # noqa: BLE001 — a response beats a hang
+            self._send_json(500, {"error": f"generation failed: {e}"})
+            return
+        try:
+            self._send_chunked_start(200, "application/x-ndjson")
+            self._send_chunk(
+                json.dumps(first).encode("utf-8") + b"\n"
+            )
+            try:
+                for ev in events:  # stops itself after a terminal event
+                    self._send_chunk(
+                        json.dumps(ev).encode("utf-8") + b"\n"
+                    )
+            except TimeoutError as e:
+                # headers are long gone — the failure IS an event
+                self._send_chunk(
+                    json.dumps(
+                        {"event": "error", "error": str(e)}
+                    ).encode("utf-8") + b"\n"
+                )
+            self._end_chunks()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # client hung up mid-stream; the connection is unusable
+            self.close_connection = True
+
 
 class ServeServer:
     """HTTP listener over one engine (engine + micro-batcher) or a
@@ -208,11 +320,25 @@ class ServeServer:
         if router is not None:
             self.batcher = None
             self.metrics = router.pool.registry
+            # a stream-mode fleet serves /generate; a predict fleet
+            # serves /predict — the pool's build flag decides
+            self.gen_mode = bool(getattr(router.pool, "stream", False))
+        elif hasattr(engine, "admit"):  # GenerationEngine duck type
+            # share the engine pool's registry so ONE /metrics payload
+            # carries the stream series AND the sparknet_kv_* arena
+            # gauges (the standalone-server contract in kv_cache.py)
+            self.batcher = StreamBatcher(
+                engine, max_queue=max_queue,
+                metrics=engine.pool.metrics,
+            )
+            self.metrics = self.batcher.metrics
+            self.gen_mode = True
         else:
             self.batcher = MicroBatcher(
                 engine, max_queue=max_queue, max_wait_ms=max_wait_ms
             )
             self.metrics = self.batcher.metrics
+            self.gen_mode = False
         # front-end series ride on the SAME shared registry the backend
         # built (obs.metrics) — one /metrics payload, no second registry
         t0 = time.monotonic()
@@ -253,6 +379,15 @@ class ServeServer:
         if self.router is not None:
             return self.router.submit(x, timeout=timeout)
         return self.batcher.submit(x, timeout=timeout)
+
+    def submit_stream(self, prompt, max_new):
+        """Event iterator for one generation stream (gen mode only)."""
+        if self.router is not None:
+            return self.router.submit_stream(
+                prompt, max_new, timeout=self.request_timeout_s
+            )
+        st = self.batcher.submit_stream(prompt, max_new)
+        return st.iter_events(timeout=self.request_timeout_s)
 
     @property
     def draining(self) -> bool:

@@ -792,31 +792,66 @@ def cmd_serve(args) -> int:
     shared-nothing replicas behind a load-shedding router;
     ``--watch`` adds the delivery controller (``serve/delivery.py``)
     canarying snapshots that ``cli train --publish_to`` publishes
-    there, promoting or rolling back with no restart."""
+    there, promoting or rolling back with no restart.
+
+    ``--generate`` serves a TransformerLM checkpoint instead
+    (``serve/generate.py``): prefill/decode-disaggregated greedy
+    decoding over a paged KV arena with continuous batching, token
+    streaming on chunked-NDJSON ``POST /generate``; the fleet and
+    delivery flags compose unchanged (streams resume on a sibling
+    replica after a replica death, promotes drop zero in-flight
+    decodes)."""
     from sparknet_tpu import config, models, obs
     from sparknet_tpu.serve import (
         DeliveryController,
+        GenerationEngine,
         InferenceEngine,
         ReplicaPool,
         Router,
         ServeServer,
     )
 
-    netp = (
-        config.load_net_prototxt(args.net)
-        if args.net.endswith(".prototxt")
-        else models.load_model(args.net)
-    )
-    buckets = [int(b) for b in args.buckets.split(",") if b.strip()]
+    if args.generate:
+        from sparknet_tpu.models.transformer_lm import TransformerLM
 
-    def make_engine(weights=None):
-        return InferenceEngine(
-            netp,
-            weights=weights if weights is not None else args.weights,
-            buckets=buckets,
-            output_blob=args.output_blob,
-            compute_dtype=args.dtype or None,
+        lm = TransformerLM(
+            dim=args.lm_dim, depth=args.lm_depth, heads=args.lm_heads,
+            seq_len=args.lm_seq_len,
         )
+        gen_buckets = [
+            int(b) for b in args.prefill_buckets.split(",") if b.strip()
+        ]
+
+        def make_engine(weights=None):
+            return GenerationEngine(
+                lm,
+                weights=weights if weights is not None else args.weights,
+                prefill_buckets=gen_buckets,
+                max_streams=args.max_streams,
+                kv_blocks=args.kv_blocks,
+                kv_block_size=args.kv_block_size,
+            )
+
+    else:
+        if not args.net:
+            print("serve: --net is required without --generate",
+                  file=sys.stderr)
+            return 2
+        netp = (
+            config.load_net_prototxt(args.net)
+            if args.net.endswith(".prototxt")
+            else models.load_model(args.net)
+        )
+        buckets = [int(b) for b in args.buckets.split(",") if b.strip()]
+
+        def make_engine(weights=None):
+            return InferenceEngine(
+                netp,
+                weights=weights if weights is not None else args.weights,
+                buckets=buckets,
+                output_blob=args.output_blob,
+                compute_dtype=args.dtype or None,
+            )
 
     # telemetry (--obs/--ship_to/...): the fleet registers its series on
     # the shared training registry so the PR-10 shipper ships the
@@ -832,19 +867,32 @@ def cmd_serve(args) -> int:
                 max_queue=args.queue,
                 max_wait_ms=args.max_wait_ms,
                 registry=tm.registry if tm is not None else None,
+                stream=args.generate,
             )
             router = Router(
                 pool, max_inflight=args.queue,
                 canary_frac=args.canary_frac,
             )
-            print(
-                "serve: fleet of %d replica(s) warmed (%d bucket "
-                "programs each: %s), input %s"
-                % (
-                    len(pool.replicas), len(buckets), buckets,
-                    pool.item_shape,
+            if args.generate:
+                print(
+                    "serve: generation fleet of %d replica(s) warmed "
+                    "(prefill buckets %s, %d decode slots, %d x %d "
+                    "KV blocks each) — POST /generate streams NDJSON"
+                    % (
+                        len(pool.replicas), gen_buckets,
+                        args.max_streams, args.kv_blocks,
+                        args.kv_block_size,
+                    )
                 )
-            )
+            else:
+                print(
+                    "serve: fleet of %d replica(s) warmed (%d bucket "
+                    "programs each: %s), input %s"
+                    % (
+                        len(pool.replicas), len(buckets), buckets,
+                        pool.item_shape,
+                    )
+                )
             if args.watch:
                 delivery = DeliveryController(
                     pool, router, args.watch,
@@ -864,11 +912,22 @@ def cmd_serve(args) -> int:
         else:
             engine = make_engine()
             n = engine.warmup()
-            print(
-                f"serve: warmed {n} bucket programs {engine.buckets} "
-                f"for input {engine.item_shape}, output blob "
-                f"{engine.output_blob!r}"
-            )
+            if args.generate:
+                print(
+                    "serve: warmed %d programs (prefill buckets %s + "
+                    "decode + score), %d decode slots, %d x %d KV "
+                    "blocks — POST /generate streams NDJSON"
+                    % (
+                        n, engine.buckets, args.max_streams,
+                        args.kv_blocks, args.kv_block_size,
+                    )
+                )
+            else:
+                print(
+                    f"serve: warmed {n} bucket programs "
+                    f"{engine.buckets} for input {engine.item_shape}, "
+                    f"output blob {engine.output_blob!r}"
+                )
             server = ServeServer(
                 engine,
                 host=args.host,
@@ -1215,8 +1274,9 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_convert_mnist)
 
     p = sub.add_parser("serve")
-    p.add_argument("--net", required=True,
-                   help="deploy prototxt or zoo model name")
+    p.add_argument("--net", default=None,
+                   help="deploy prototxt or zoo model name (required "
+                   "unless --generate)")
     p.add_argument("--weights", default=None,
                    help=".caffemodel / .caffemodel.h5 (snapshot output ok)")
     p.add_argument("--host", default="127.0.0.1")
@@ -1255,6 +1315,30 @@ def main(argv=None) -> int:
     p.add_argument("--cache_dir", default=None,
                    help="chunk-cache root for the delivery watcher's "
                    "verified snapshot staging (default: a temp dir)")
+    p.add_argument("--generate", action="store_true",
+                   help="serve a TransformerLM checkpoint for token "
+                   "streaming (serve/generate.py): chunked-NDJSON "
+                   "POST /generate, continuous batching over a paged "
+                   "KV arena; composes with --replicas/--watch")
+    p.add_argument("--lm_dim", type=int, default=256,
+                   help="--generate: TransformerLM embedding dim")
+    p.add_argument("--lm_depth", type=int, default=4,
+                   help="--generate: TransformerLM layers")
+    p.add_argument("--lm_heads", type=int, default=4,
+                   help="--generate: TransformerLM attention heads")
+    p.add_argument("--lm_seq_len", type=int, default=256,
+                   help="--generate: model context length")
+    p.add_argument("--prefill_buckets", default="16,32,64,128",
+                   help="--generate: prompt-length buckets to "
+                   "pre-compile (longer prompts -> 400)")
+    p.add_argument("--max_streams", type=int, default=8,
+                   help="--generate: decode slots (the fixed decode "
+                   "batch width)")
+    p.add_argument("--kv_blocks", type=int, default=64,
+                   help="--generate: paged KV arena blocks (worst-case "
+                   "reservation at admission; overflow -> 429)")
+    p.add_argument("--kv_block_size", type=int, default=16,
+                   help="--generate: positions per KV block")
     _obs.add_cli_args(p)  # --obs/--ship_to/...: fleet series ride the shipper
     p.set_defaults(fn=cmd_serve)
 

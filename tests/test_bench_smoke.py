@@ -125,6 +125,7 @@ def test_unknown_mode_rejected():
     assert "elastic" in out.stderr  # ... and the elastic-membership mode
     assert "recover" in out.stderr  # ... and the crash-consistency mode
     assert "|lm" in out.stderr  # ... and the transformer-LM mode
+    assert "genserve" in out.stderr  # ... and the generation-serving mode
     # env-var route rejects identically
     out = subprocess.run(
         [sys.executable, os.path.join(_REPO, "bench.py")],
@@ -440,6 +441,7 @@ def test_perf_gate_passes_over_committed_artifacts():
     for fam in (
         "PIPELINE", "OBS", "HEALTH", "CHAOS", "SERVE", "PROFILE",
         "DATACACHE", "SANITIZE", "FLEET", "DELIVERY", "ELASTIC",
+        "RECOVER", "LM", "GENSERVE",
     ):
         assert fam in gated, fam
 
@@ -1244,4 +1246,103 @@ def test_committed_lm_artifact_schema():
     )
     # honesty notes: CPU box + modeled-bytes convention disclosed
     assert "modeled" in d["note"].lower()
+    assert "cpu" in d["note"].lower()
+
+
+@pytest.mark.slow
+def test_genserve_mode_smoke():
+    """bench.py --mode=genserve end to end in a subprocess, trimmed to
+    a short run (the committed artifact pins the full sweep): the
+    continuous-batching A/B streams token-identical output, nothing
+    recompiles after warmup, the KV arena accounts exactly, and the
+    stream-fleet promote/rollback legs land."""
+    rec = _run_bench({
+        "BENCH_MODE": "genserve", "BENCH_GEN_JOBS": "6",
+        "BENCH_GEN_SLOTS": "2", "BENCH_GEN_SHORT": "4",
+        "BENCH_GEN_LONG": "12", "BENCH_GEN_STORM_CLIENTS": "6",
+        "BENCH_GEN_STORM_STREAMS": "1", "BENCH_GEN_DECISION": "2",
+    })
+    assert rec["metric"] == "genserve_continuous_tokens_per_s"
+    assert rec["value"] > 0
+    assert rec["ab_tokens_identical"] is True
+    assert rec["post_warmup_recompiles"] == 0
+    assert rec["kv_exact"] is True
+    assert rec["kv_blocks_in_use_after_drain"] == 0
+    assert rec["storm_errors"] == 0
+    assert rec["promote_ok"] is True
+    assert rec["promote_dropped_streams"] == 0
+    assert rec["rollback_exact"] is True
+    assert rec["incumbent_held_after_rollback"] is True
+
+
+_GENSERVE_SCHEMA_KEYS = (
+    "metric", "value", "unit", "vs_baseline", "platform", "jobs",
+    "decode_slots", "short_max_new", "long_max_new", "prefill_buckets",
+    "static_tokens_per_s", "continuous_tokens_per_s",
+    "continuous_vs_static_ratio", "ab_tokens_identical", "storm_offered",
+    "storm_served", "storm_shed_429", "storm_errors",
+    "storm_p50_ttft_ms", "storm_p99_ttft_ms", "jit_cache_entries",
+    "post_warmup_recompiles", "kv_allocated_total", "kv_freed_total",
+    "kv_blocks_in_use_after_drain", "kv_exact", "promoted_publish",
+    "good_publish", "promote_ok", "promote_dropped_streams",
+    "promote_token_identical", "promote_max_divergence",
+    "divergence_max", "bad_publish", "rollback_named_publish",
+    "rollback_exact", "rollback_divergence", "rollback_dropped_streams",
+    "incumbent_held_after_rollback", "traffic_ok", "traffic_shed",
+    "note",
+)
+
+
+def test_committed_genserve_artifact_schema():
+    """GENSERVE_r19.json — the autoregressive-serving committed
+    artifact (ISSUE 16 done-bars): continuous batching strictly beats
+    the static-batch baseline on the SAME warm engine with
+    token-identical greedy output, the admission storm sheds 429 with
+    zero errors and a bounded TTFT tail, nothing recompiles after
+    warmup, the paged KV arena accounts exactly (allocated == freed, 0
+    in use after drain), the good publish promotes with zero dropped
+    in-flight decodes and a token-identical probe, and the
+    forged-verdict poisoned publish rolls back NAMED on per-token
+    logprob divergence with the incumbent held."""
+    with open(os.path.join(_REPO, "GENSERVE_r19.json")) as f:
+        d = json.load(f)
+    for key in _GENSERVE_SCHEMA_KEYS:
+        assert key in d, key
+    assert d["metric"] == "genserve_continuous_tokens_per_s"
+    assert d["unit"] == "tokens/s/replica"
+    assert d["value"] == d["continuous_tokens_per_s"] > 0
+    # the headline A/B: continuous batching wins, output identical
+    assert d["vs_baseline"] == d["continuous_vs_static_ratio"] >= 1.05
+    assert d["continuous_tokens_per_s"] > d["static_tokens_per_s"] > 0
+    assert d["ab_tokens_identical"] is True
+    # admission storm: bounded (429s really fired), zero errors, and
+    # accounting closes (offered = served + shed)
+    assert d["storm_offered"] == d["storm_served"] + d["storm_shed_429"]
+    assert d["storm_shed_429"] > 0 and d["storm_errors"] == 0
+    assert 0 < d["storm_p50_ttft_ms"] <= d["storm_p99_ttft_ms"] < 2000.0
+    # prefill per bucket + decode + score, pinned after warmup
+    assert d["jit_cache_entries"] == len(d["prefill_buckets"]) + 2
+    assert d["post_warmup_recompiles"] == 0
+    # exact paged-KV accounting across every arena in the run
+    assert d["kv_exact"] is True
+    assert d["kv_allocated_total"] == d["kv_freed_total"] > 0
+    assert d["kv_blocks_in_use_after_drain"] == 0
+    # promote under live generation traffic: zero dropped decodes,
+    # token-identical probe, divergence far inside the pin
+    assert d["promote_ok"] is True
+    assert d["promoted_publish"] == d["good_publish"]
+    assert d["promote_dropped_streams"] == 0
+    assert d["promote_token_identical"] is True
+    assert 0 <= d["promote_max_divergence"] <= d["divergence_max"]
+    # canary-divergence rollback: named at exactly the poisoned
+    # publish, divergence decisively outside the pin, incumbent held
+    assert d["rollback_exact"] is True
+    assert d["rollback_named_publish"] == d["bad_publish"]
+    assert d["rollback_named_publish"] != d["good_publish"]
+    assert d["rollback_divergence"] > d["divergence_max"]
+    assert d["rollback_dropped_streams"] == 0
+    assert d["incumbent_held_after_rollback"] is True
+    # live traffic really flowed around the swaps
+    assert d["traffic_ok"] > 0
+    # the CPU-box honesty note rides along
     assert "cpu" in d["note"].lower()

@@ -55,6 +55,12 @@ def test_default_plan_covers_every_fault_class():
     assert plan.replica_death_round > plan.preempt_round
     assert plan.publish_corrupt_round is not None
     assert plan.publish_corrupt_round > plan.replica_death_round
+    # the decode-kill fault (round 19): a generation replica killed
+    # mid-stream — also after the preemption (lazy gen fleet on the
+    # resumed process), before the corrupt publish's round
+    assert plan.decode_replica_kill_round is not None
+    assert plan.decode_replica_kill_round > plan.preempt_round
+    assert plan.decode_replica_kill_round <= plan.publish_corrupt_round
     # the slice preemption (round 16): the SIGTERM notice fires BEFORE
     # the SIGHUP process death (the leave must land pre-resume so the
     # replay can't re-fire it), the preempted slice is a real
@@ -92,6 +98,7 @@ def test_no_fault_view_strips_all_faults():
     assert base.cache_corrupt_round is None
     assert base.cache_cold_round is None
     assert base.replica_death_round is None
+    assert base.decode_replica_kill_round is None
     assert base.publish_corrupt_round is None
     assert base.slice_preempt_round is None
     assert base.driver_kill_round is None
@@ -251,6 +258,11 @@ def test_chaos_smoke_default_plan(tmp_path):
     # publish dir (it never reached a canary)
     assert rep["faults"]["replica_death"]["survived"] == 1
     assert rep["faults"]["published_snapshot_corrupt"]["survived"] == 1
+    # the decode-kill fault (round 19): a generation replica was
+    # hard-killed mid-stream and the stream RESUMED on the sibling via
+    # re-prefill with a token-identical continuation
+    assert rep["faults"]["decode_replica_kill"]["survived"] == 1
+    assert rep["decode_replica_kill_round"] is not None
     pub_dir = os.path.join(str(tmp_path), "publish")
     assert any(
         f.endswith(".corrupt") for f in os.listdir(pub_dir)

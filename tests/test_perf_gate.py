@@ -515,3 +515,85 @@ def test_lm_family_rules(tmp_path):
     _write(tmp_path, "LM_r19.json", bad)
     rc, rows = g.check(str(tmp_path))
     assert rc == 1
+
+
+GOOD_GENSERVE = {
+    "value": 11000.0, "continuous_vs_static_ratio": 1.25,
+    "ab_tokens_identical": True, "storm_shed_429": 24,
+    "storm_errors": 0, "storm_p99_ttft_ms": 2.0,
+    "post_warmup_recompiles": 0, "kv_exact": True,
+    "kv_blocks_in_use_after_drain": 0, "kv_allocated_total": 8762,
+    "kv_freed_total": 8762, "promote_ok": True,
+    "promote_dropped_streams": 0, "promote_token_identical": True,
+    "promote_max_divergence": 3.6e-7, "divergence_max": 1e-3,
+    "rollback_divergence": 15.2, "rollback_exact": True,
+    "rollback_dropped_streams": 0,
+    "incumbent_held_after_rollback": True,
+}
+
+
+def test_genserve_family_rules(tmp_path):
+    """The GENSERVE family (ISSUE 16): continuous batching beats static
+    with identical greedy tokens, a real 429 storm with zero errors and
+    a bounded TTFT tail, zero recompiles after warmup, exact KV-block
+    accounting, zero-drop promotes with a token-identical probe, and
+    divergence-named rollbacks — any one regressing fails --check."""
+    g = _gate()
+    _write(tmp_path, "GENSERVE_r19.json", GOOD_GENSERVE)
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 0, rows
+    for bad_field, bad_value in (
+        ("continuous_vs_static_ratio", 1.0),  # scheduling won nothing
+        ("ab_tokens_identical", False),    # batching changed the output
+        ("storm_shed_429", 0),             # vacuous: admission never bit
+        ("storm_errors", 2),               # shed leaked as errors
+        ("storm_p99_ttft_ms", 5000.0),     # first token unbounded
+        ("post_warmup_recompiles", 1),     # the serving contract broke
+        ("kv_exact", False),               # arena accounting drifted
+        ("kv_blocks_in_use_after_drain", 3),  # leaked KV blocks
+        ("promote_ok", False),             # wrong snapshot promoted
+        ("promote_dropped_streams", 2),    # promote dropped decodes
+        ("promote_token_identical", False),  # hot-swap changed tokens
+        ("rollback_exact", False),         # wrong publish named
+        ("rollback_dropped_streams", 1),   # rollback dropped decodes
+        ("incumbent_held_after_rollback", False),
+    ):
+        _write(
+            tmp_path, "GENSERVE_r20.json",
+            dict(GOOD_GENSERVE, **{bad_field: bad_value}),
+        )
+        rc, rows = g.check(str(tmp_path))
+        assert rc == 1, bad_field
+        assert any(
+            bad_field in r["detail"] for r in rows if not r["ok"]
+        ), (bad_field, rows)
+    # the KV extra rule: allocated must equal freed AND be nonzero —
+    # an imbalance or a vacuous zero fails even with kv_exact True
+    for kv in (
+        {"kv_allocated_total": 8762, "kv_freed_total": 8760},
+        {"kv_allocated_total": 0, "kv_freed_total": 0},
+    ):
+        _write(
+            tmp_path, "GENSERVE_r20.json", dict(GOOD_GENSERVE, **kv)
+        )
+        rc, rows = g.check(str(tmp_path))
+        assert rc == 1, kv
+        assert any(
+            "kv_allocated_total" in r["detail"]
+            for r in rows if not r["ok"]
+        ), (kv, rows)
+    # the divergence extra rule: the canary decision must be decisive
+    # against the artifact's OWN pin — a good publish outside the pin,
+    # or a poisoned publish inside it, fails even with the flags True
+    for div in (
+        {"promote_max_divergence": 5e-3},   # good publish out of band
+        {"rollback_divergence": 5e-4},      # bad publish inside the pin
+    ):
+        _write(
+            tmp_path, "GENSERVE_r20.json", dict(GOOD_GENSERVE, **div)
+        )
+        rc, rows = g.check(str(tmp_path))
+        assert rc == 1, div
+        assert any(
+            "divergence_max" in r["detail"] for r in rows if not r["ok"]
+        ), (div, rows)

@@ -1,7 +1,10 @@
 """Integration tests: a live ServeServer on a toy net under concurrent
 HTTP clients — correct per-request outputs (match single-shot forward),
 zero recompiles after warmup, nonzero batch occupancy in /metrics, 429
-load-shedding at queue capacity, and clean drain."""
+load-shedding at queue capacity, and clean drain.  The second half runs
+the server in generation mode: chunked NDJSON token streaming over
+POST /generate, route gating, 400/429-with-Retry-After admission, and
+drain semantics extended to live streams."""
 
 import json
 import threading
@@ -12,7 +15,8 @@ import numpy as np
 import pytest
 
 from sparknet_tpu import config
-from sparknet_tpu.serve import InferenceEngine, ServeServer
+from sparknet_tpu.models.transformer_lm import TransformerLM
+from sparknet_tpu.serve import GenerationEngine, InferenceEngine, ServeServer
 
 TOY_DEPLOY = """
 name: "toy"
@@ -263,3 +267,182 @@ def test_graceful_drain_completes_inflight_work():
         t.join(30)
     # the three parked requests were served, not dropped
     assert results == [200, 200, 200]
+
+
+# ---------------------------------------------------------------------------
+# generation mode: POST /generate chunked NDJSON streaming
+# ---------------------------------------------------------------------------
+def _post_generate(base, payload, timeout=120):
+    """POST /generate; returns (status, content_type, parsed NDJSON
+    lines).  urllib consumes the chunked stream to completion."""
+    req = urllib.request.Request(
+        base + "/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        ctype = r.headers.get("Content-Type", "")
+        lines = [
+            json.loads(ln)
+            for ln in r.read().decode().splitlines()
+            if ln.strip()
+        ]
+        return r.status, ctype, lines
+
+
+def _make_gen_engine(max_streams=2, kv_blocks=24):
+    lm = TransformerLM(dim=32, depth=2, heads=2, seq_len=32, vocab=64)
+    engine = GenerationEngine(
+        lm, prefill_buckets=(8, 32), max_streams=max_streams,
+        kv_blocks=kv_blocks, kv_block_size=4, seed=0,
+    )
+    engine.warmup()
+    return engine
+
+
+@pytest.fixture()
+def gen_server():
+    engine = _make_gen_engine()
+    srv = ServeServer(engine, port=0, max_queue=8)
+    srv.start()
+    host, port = srv.address
+    yield srv, engine, f"http://{host}:{port}"
+    srv.shutdown()
+
+
+def test_generate_streams_ndjson_token_events(gen_server):
+    _srv, engine, base = gen_server
+    payload = {"prompt": [5, 9, 2], "max_new": 12}
+    status, ctype, events = _post_generate(base, payload)
+    assert status == 200
+    assert ctype.startswith("application/x-ndjson")
+    toks = [ev for ev in events if ev["event"] == "token"]
+    done = events[-1]
+    assert done["event"] == "done"
+    assert done["finish_reason"] == "length"
+    # one event per token, indexed in order, consistent with the final
+    assert [ev["index"] for ev in toks] == list(range(12))
+    assert [ev["token"] for ev in toks] == done["tokens"]
+    # greedy decode is deterministic: a second request streams the
+    # identical tokens
+    _s, _c, again = _post_generate(base, payload)
+    assert again[-1]["tokens"] == done["tokens"]
+    # all KV blocks returned once the streams finished
+    _status, metrics = _get(base, "/metrics")
+    assert "sparknet_gen_tokens_total" in metrics
+    assert "sparknet_kv_blocks_used 0" in metrics
+    assert engine.pool.used() == 0
+
+
+def test_generate_route_gating_404s(server, gen_server):
+    """/predict and /generate are mode-gated: each 404s (with a hint)
+    on the server of the other mode."""
+    _s1, _e1, clf_base = server
+    _s2, _e2, gen_base = gen_server
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post_generate(clf_base, {"prompt": [1], "max_new": 2})
+    assert ei.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post_predict(gen_base, np.zeros((1, 3, 8, 8), np.float32))
+    assert ei.value.code == 404
+
+
+def test_generate_bad_input_is_400(gen_server):
+    _srv, _engine, base = gen_server
+    bad = [
+        b"not json",
+        b"{}",  # no prompt
+        b'{"prompt": []}',  # empty prompt
+        b'{"prompt": [1, 2], "max_new": 0}',
+        b'{"prompt": "abc"}',  # tokens, not text
+        # geometry: prompt longer than the largest prefill bucket
+        json.dumps({"prompt": [1] * 40, "max_new": 2}).encode(),
+    ]
+    for payload in bad:
+        req = urllib.request.Request(base + "/generate", data=payload)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 400, payload
+
+
+def test_generate_storm_sheds_429_with_retry_after():
+    """One decode slot + queue of one: a burst of streams must shed
+    with 429 + Retry-After while every admitted stream completes."""
+    engine = _make_gen_engine(max_streams=1, kv_blocks=12)
+    srv = ServeServer(engine, port=0, max_queue=1)
+    srv.start()
+    host, port = srv.address
+    base = f"http://{host}:{port}"
+    try:
+        codes, retry_after = [], []
+        lock = threading.Lock()
+
+        def client():
+            try:
+                status, _c, events = _post_generate(
+                    base, {"prompt": [3, 1], "max_new": 16}
+                )
+                ok = events[-1]["event"] == "done"
+                with lock:
+                    codes.append(status if ok else -1)
+            except urllib.error.HTTPError as e:
+                with lock:
+                    codes.append(e.code)
+                    retry_after.append(e.headers.get("Retry-After"))
+
+        threads = [threading.Thread(target=client) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert codes.count(429) >= 1, codes
+        assert codes.count(200) >= 1, codes
+        assert set(codes) <= {200, 429}, codes
+        assert all(ra == "1" for ra in retry_after), retry_after
+        _status, metrics = _get(base, "/metrics")
+        assert "sparknet_gen_streams_shed_total" in metrics
+    finally:
+        srv.shutdown()
+    assert engine.pool.used() == 0
+    assert engine.pool.allocated_total == engine.pool.freed_total
+
+
+def test_generate_drain_refuses_new_finishes_inflight():
+    """initiate_drain: health flips 503, new /generate requests are
+    refused 503, and the in-flight stream still runs to its natural
+    'done' through shutdown — zero dropped decodes."""
+    engine = _make_gen_engine()
+    srv = ServeServer(engine, port=0, max_queue=8)
+    srv.start()
+    host, port = srv.address
+    base = f"http://{host}:{port}"
+
+    results = []
+
+    def client():
+        results.append(
+            _post_generate(base, {"prompt": [5, 9], "max_new": 24})
+        )
+
+    t = threading.Thread(target=client)
+    t.start()
+    while srv.batcher.active_count() < 1:
+        threading.Event().wait(0.005)
+
+    srv.initiate_drain()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(base, "/healthz")
+    assert ei.value.code == 503
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post_generate(base, {"prompt": [1], "max_new": 2})
+    assert ei.value.code == 503
+    assert ei.value.headers.get("Retry-After") == "1"
+
+    srv.shutdown()
+    t.join(60)
+    assert len(results) == 1
+    status, _ctype, events = results[0]
+    assert status == 200
+    assert events[-1]["event"] == "done"
+    assert len(events[-1]["tokens"]) == 24
+    assert engine.pool.used() == 0

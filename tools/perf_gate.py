@@ -243,6 +243,37 @@ RULES: Dict[str, List[Rule]] = {
         Rule("ring_hop_bytes_per_round", ">", 0),
         Rule("tokens_per_round", ">", 0),
     ],
+    "GENSERVE": [
+        # the autoregressive generation-serving contract (bench.py
+        # --mode=genserve): continuous batching strictly beats static
+        # generation-level batching on the mixed-length workload with
+        # IDENTICAL greedy token sequences (the ratio isolates
+        # scheduling — the absolute tokens/s is this CPU box's number,
+        # disclosed ungated), the 429 storm sheds at admission (never
+        # a mid-stream OOM) with client-measured p99 TTFT bounded,
+        # ZERO recompiles after warmup across every leg, KV-block
+        # accounting exact at drain, the verdicted publish promotes
+        # under live stream traffic with zero dropped decodes and a
+        # token-identical probe, and the forged-verdict poisoned
+        # publish rolls back on per-token logprob divergence with the
+        # incumbent held (the extra rules below compare the measured
+        # divergences against the artifact's own pin)
+        Rule("value", ">", 0),
+        Rule("continuous_vs_static_ratio", ">=", 1.05),
+        Rule("ab_tokens_identical", "is", True),
+        Rule("storm_shed_429", ">", 0),
+        Rule("storm_errors", "==", 0),
+        Rule("storm_p99_ttft_ms", "<", 2000.0),
+        Rule("post_warmup_recompiles", "==", 0),
+        Rule("kv_exact", "is", True),
+        Rule("kv_blocks_in_use_after_drain", "==", 0),
+        Rule("promote_ok", "is", True),
+        Rule("promote_dropped_streams", "==", 0),
+        Rule("promote_token_identical", "is", True),
+        Rule("rollback_exact", "is", True),
+        Rule("rollback_dropped_streams", "==", 0),
+        Rule("incumbent_held_after_rollback", "is", True),
+    ],
     "DATACACHE": [
         # the I/O-flat contract: a warm (cache-filled, shuffled-
         # assignment) epoch makes ZERO network fetches and is strictly
@@ -347,12 +378,38 @@ def _recover_survival_rule(art: dict) -> Tuple[bool, str]:
     )
 
 
+def _genserve_kv_rule(art: dict) -> Tuple[bool, str]:
+    a, f = art.get("kv_allocated_total"), art.get("kv_freed_total")
+    ok = bool(a is not None and a > 0 and a == f)
+    return ok, (
+        "kv_allocated_total=%r == kv_freed_total=%r (and > 0)" % (a, f)
+    )
+
+
+def _genserve_divergence_rule(art: dict) -> Tuple[bool, str]:
+    """The canary decision must be decisive against the artifact's OWN
+    pin: the good publish's per-token logprob divergence sits inside
+    it, the poisoned publish's strictly outside."""
+    pin = art.get("divergence_max")
+    good = art.get("promote_max_divergence")
+    bad = art.get("rollback_divergence")
+    ok = bool(
+        pin is not None and good is not None and bad is not None
+        and 0 <= good <= pin < bad
+    )
+    return ok, (
+        "promote_max_divergence=%r <= divergence_max=%r < "
+        "rollback_divergence=%r" % (good, pin, bad)
+    )
+
+
 _EXTRA_RULES = {
     "CHAOS": [_chaos_survival_rule],
     "PIPELINE": [_pipeline_order_rule],
     "ELASTIC": [_elastic_ratio_rule],
     "RECOVER": [_recover_survival_rule],
     "LM": [_lm_tolerance_rule],
+    "GENSERVE": [_genserve_kv_rule, _genserve_divergence_rule],
 }
 
 
