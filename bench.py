@@ -242,7 +242,7 @@ if _REPO not in sys.path:
 _MODES = (
     "train", "hostfeed", "scaling", "serve", "chaos", "pipeline", "obs",
     "health", "profile", "datacache", "sanitize", "fleet", "delivery",
-    "elastic", "recover", "lm", "genserve",
+    "elastic", "recover", "lm", "genserve", "stale",
 )
 _MODE = os.environ.get("BENCH_MODE", "train")
 for _i, _a in enumerate(sys.argv[1:], start=1):
@@ -261,7 +261,7 @@ if _MODE not in _MODES:
         % (_MODE, "|".join(_MODES))
     )
 if _MODE in ("scaling", "chaos", "pipeline", "obs", "health", "profile",
-             "sanitize", "fleet", "elastic", "lm"):
+             "sanitize", "fleet", "elastic", "lm", "stale"):
     # these modes need >1 device; on a 1-chip host force the virtual CPU
     # mesh (the driver's multichip validation environment).  This must run
     # BEFORE the first backend use (XLA_FLAGS is parsed once per process),
@@ -4501,6 +4501,389 @@ def bench_recover():
     print(json.dumps(out))
 
 
+def bench_stale():
+    """Bounded-staleness averaging proof (``parallel/stale.py``,
+    ``--stale_bound``): a straggler costs ~0 wall-clock at equal final
+    loss, and B=0 IS the synchronous trainer.
+
+    Three legs on the virtual CPU mesh:
+
+    1. **B=0 bit-identity pin** — ``BoundedStalenessTrainer`` with
+       ``stale_bound=0`` must produce TrainStates BITWISE identical to
+       ``ParameterAveragingTrainer`` over the same seeded rounds, flat
+       AND two-tier (the degenerate path is sync averaging, not an
+       approximation of it).
+    2. **straggler wall-clock A/B** — the same seeded run three ways:
+       a no-straggler sync baseline; a sync control where one worker
+       carries a +tail_s TRANSIENT tail for K consecutive rounds (the
+       synchronous boundary waits — the whole job pays K x tail_s); a
+       bounded-staleness leg (B=BENCH_STALE_BOUND > K) where that
+       worker simply misses the straggled boundaries and folds back in
+       after the window, never bound-forced.  Judged on the straggled
+       rounds' p50 wall-clock: the stale leg must land within the
+       pinned band of the no-straggler baseline (the tail is OFF the
+       critical path) while the sync control measurably pays it; the
+       final losses must agree within the band (the speed is not
+       bought with divergence).  A PERMANENT rate deficit is the
+       non-claim: once lag hits B the bound forces a fold every
+       boundary and the job throttles to the straggler — bounded
+       staleness absorbs transient tails, nothing absorbs a standing
+       throughput gap.
+    3. **asymmetric hierarchy** — the straggler rerun two-tier
+       (2 slices, K=2): fast intra-slice boundaries, lazy stale
+       cross-slice arrivals, the straggler's slice coarsened as a
+       unit, the ledger still naming its members as the laggiest;
+       finite losses throughout.
+
+    Honesty: "running ahead" is MODELED on the virtual CPU mesh — the
+    harness decides each boundary's arrival set and models the
+    straggler's tail as a sleep the waiting side pays (the PERF.md
+    modeled-straggler convention).  The semantics (arrival masks,
+    staleness-discounted weights, worker-round ledger, forced folds)
+    are the real jitted program.
+    """
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from sparknet_tpu import config as cfg, models, obs
+    from sparknet_tpu.data import CifarLoader
+    from sparknet_tpu.parallel import (
+        BoundedStalenessTrainer,
+        HierarchySpec,
+        ParameterAveragingTrainer,
+        make_mesh,
+        shard_leading,
+        stale_window,
+    )
+    from sparknet_tpu.solver import Solver
+
+    workers = int(os.environ.get("BENCH_WORKERS", "4"))
+    tau = int(os.environ.get("BENCH_TAU", "2"))
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    rounds = int(os.environ.get("BENCH_STALE_ROUNDS", "20"))
+    B = int(os.environ.get("BENCH_STALE_BOUND", "4"))
+    discount = 0.5
+    seed = 7
+    straggler = workers - 1
+
+    workdir = tempfile.mkdtemp(prefix="bench_stale_")
+    data_dir = os.path.join(workdir, "data")
+    CifarLoader.write_synthetic(
+        data_dir, num_train=512, num_test=64, seed=seed
+    )
+    xs, ys = CifarLoader(data_dir).minibatches(batch, train=True)
+
+    def window(r):
+        n = len(xs)
+        data = np.empty((workers, tau) + xs[0].shape, np.float32)
+        label = np.empty((workers, tau, batch), np.float32)
+        for w in range(workers):
+            for t in range(tau):
+                i = (r * workers * tau + w * tau + t) % n
+                data[w, t] = xs[i]
+                label[w, t] = ys[i]
+        return {"data": data, "label": label}
+
+    netp = cfg.replace_data_layers(
+        models.load_model("cifar10_quick"),
+        [(batch, 3, 32, 32), (batch,)],
+        [(batch, 3, 32, 32), (batch,)],
+    )
+    mesh = make_mesh({"dp": workers}, devices=jax.devices()[:workers])
+    tm = obs.enable_training_metrics()
+
+    def solver():
+        return Solver(
+            models.load_model_solver("cifar10_quick"), net_param=netp
+        )
+
+    def sync_trainer(spec=None):
+        return ParameterAveragingTrainer(solver(), mesh, hierarchy=spec)
+
+    def stale_trainer(bound, spec=None):
+        return BoundedStalenessTrainer(
+            solver(), mesh, stale_bound=bound, discount=discount,
+            hierarchy=spec,
+        )
+
+    def bitwise(a, b):
+        for x, y in zip(
+            jax.tree_util.tree_leaves(jax.device_get(a)),
+            jax.tree_util.tree_leaves(jax.device_get(b)),
+        ):
+            if not np.array_equal(np.asarray(x), np.asarray(y)):
+                return False
+        return True
+
+    # ---- leg 1: B=0 bit-identity, flat + two-tier ------------------
+    ident_rounds = 3
+    hier = HierarchySpec.grouped(workers, 2, 2)
+
+    def run_sync(trainer, n):
+        state = trainer.init_state(seed=seed)
+        for r in range(n):
+            state, _ = trainer.round(
+                state, shard_leading(window(r), mesh), round_index=r
+            )
+        return state
+
+    def run_b0(trainer, n):
+        state = trainer.init_state(seed=seed)
+        for r in range(n):
+            state, _ = trainer.round(
+                state, shard_leading(window(r), mesh),
+                arrived=np.ones((workers,), bool), round_index=r,
+            )
+        return state
+
+    b0_flat = bitwise(
+        run_sync(sync_trainer(), ident_rounds),
+        run_b0(stale_trainer(0), ident_rounds),
+    )
+    b0_hier = bitwise(
+        run_sync(sync_trainer(hier), ident_rounds),
+        run_b0(stale_trainer(0, hier), ident_rounds),
+    )
+    b0_bit_identical = bool(b0_flat and b0_hier)
+    print(
+        "stale: B=0 bit-identical to sync round: flat %s, two-tier %s "
+        "(%d rounds)" % (b0_flat, b0_hier, ident_rounds),
+        file=sys.stderr,
+    )
+
+    # ---- leg 2: straggler wall-clock A/B ---------------------------
+    def p50(ms):
+        s = sorted(ms)
+        return s[len(s) // 2] if s else 0.0
+
+    # the straggled window: K consecutive slow rounds, K < B so the
+    # bound never forces a mid-tail wait
+    K = int(os.environ.get("BENCH_STALE_SLOW_ROUNDS", str(min(6, B - 1))))
+    if K >= B:
+        sys.exit("bench stale: BENCH_STALE_SLOW_ROUNDS must be < bound")
+    slow_rounds = set(range(1, 1 + K))
+
+    def timed_sync(tail_s):
+        trainer = sync_trainer()
+        state = trainer.init_state(seed=seed)
+        per_round = []
+        losses = None
+        for r in range(rounds):
+            t0 = time.perf_counter()
+            if tail_s and r in slow_rounds:
+                # the synchronous boundary cannot proceed without the
+                # straggler: the whole job eats the tail
+                time.sleep(tail_s)
+            state, losses = trainer.round(
+                state, shard_leading(window(r), mesh), round_index=r
+            )
+            jax.block_until_ready(losses)
+            per_round.append((time.perf_counter() - t0) * 1e3)
+        # steady rounds only: round 0 carries the jit compile
+        return per_round, float(
+            np.mean(np.asarray(jax.device_get(losses)))
+        )
+
+    base_rounds_ms, baseline_loss = timed_sync(0.0)
+    base_ms = base_rounds_ms[1:]
+    # the modeled tail: comparable to this box's own compute round, so
+    # the sync control's penalty is unambiguous on any machine
+    tail_s = float(os.environ.get(
+        "BENCH_STALE_TAIL_S",
+        "%.3f" % min(3.0, max(0.4, p50(base_ms) / 1e3)),
+    ))
+    sync_rounds_ms, sync_loss = timed_sync(tail_s)
+
+    trainer = stale_trainer(B)
+    state = trainer.init_state(seed=seed)
+    stale_rounds_ms = []
+    forced_folds = 0
+    max_staleness = 0
+    losses = None
+    for r in range(rounds):
+        arrived = np.ones((workers,), bool)
+        t0 = time.perf_counter()
+        if r in slow_rounds:
+            # the straggler misses this boundary; the average takes
+            # whoever arrived and moves on — no wait, unless the bound
+            # forces a fold of the still-slow worker
+            arrived[straggler] = False
+            lag = trainer.lags(r)
+            if int(lag[straggler]) >= B:
+                forced_folds += 1
+                time.sleep(tail_s)
+        if r > 0:
+            max_staleness = max(
+                max_staleness, int(trainer.lags(r).max())
+            )
+        state, losses = trainer.round(
+            state,
+            shard_leading(
+                stale_window(window, trainer.worker_rounds), mesh
+            ),
+            arrived=arrived, round_index=r,
+        )
+        jax.block_until_ready(losses)
+        stale_rounds_ms.append((time.perf_counter() - t0) * 1e3)
+    lb = trainer.last_boundary
+    eff = np.asarray(lb["arrived"]) | np.asarray(lb["forced"])
+    larr = np.asarray(jax.device_get(losses))
+    # non-arrived workers' loss rows are zeroed by construction — the
+    # final-loss comparison reads the boundary's effective arrivals
+    stale_loss = float(np.mean(larr[eff]))
+
+    slow = sorted(slow_rounds)
+    base_p50 = p50(base_ms)
+    sync_slow_p50 = p50([sync_rounds_ms[r] for r in slow])
+    stale_slow_p50 = p50([stale_rounds_ms[r] for r in slow])
+    sync_penalty_pct = 100.0 * (sync_slow_p50 - base_p50) / base_p50
+    stale_penalty_pct = 100.0 * (stale_slow_p50 - base_p50) / base_p50
+    tail_injected_s = K * tail_s
+    saved_s = (sum(sync_rounds_ms[1:]) - sum(stale_rounds_ms[1:])) / 1e3
+    loss_band = max(0.25, 0.25 * abs(sync_loss))
+    # ONE-SIDED: staleness must not HURT convergence.  The deficit-
+    # weighted discount (discount**lag, lag = cumulative window
+    # deficit) keeps a once-straggled worker permanently down-weighted
+    # — over a long horizon the effectively-smaller averaging pool can
+    # reach LOWER train loss than the sync control, which is trajectory
+    # drift, not damage; the gated claim is "no convergence penalty"
+    loss_band_ok = bool(stale_loss <= sync_loss + loss_band)
+    staleness_gauge = float(tm.staleness.labels(str(straggler)).value)
+    print(
+        "stale: straggled-round p50 %.1f ms sync control (+%.0f%% over "
+        "the %.1f ms baseline — it pays the %.2fs tail) vs %.1f ms "
+        "stale B=%d (+%.0f%%, %d forced fold(s)); %.2fs of the %.2fs "
+        "injected tail saved | loss %.4f vs sync %.4f (one-sided "
+        "band +%.3f: %s)"
+        % (
+            sync_slow_p50, sync_penalty_pct, base_p50, tail_s,
+            stale_slow_p50, B, stale_penalty_pct, forced_folds,
+            saved_s, tail_injected_s, stale_loss, sync_loss,
+            loss_band, "OK" if loss_band_ok else "OUT",
+        ),
+        file=sys.stderr,
+    )
+
+    # ---- leg 3: asymmetric two-tier semantics ----------------------
+    hier_B = 2
+    hier_rounds = max(8, 2 * B)
+    t_h = stale_trainer(hier_B, hier)
+    state = t_h.init_state(seed=seed)
+    slice_id = next(
+        i for i, s in enumerate(hier.slices) if straggler in s
+    )
+    members = set(hier.slices[slice_id])
+    tiers = set()
+    hier_laggiest_ok = True
+    losses = None
+    for r in range(hier_rounds):
+        arrived = np.ones((workers,), bool)
+        if r >= 1:
+            arrived[straggler] = False
+        state, losses = t_h.round(
+            state,
+            shard_leading(stale_window(window, t_h.worker_rounds), mesh),
+            arrived=arrived, round_index=r,
+        )
+        tiers.add(t_h.last_boundary["tier"])
+        if r >= 1:
+            lag_after = t_h.lags(r + 1)
+            if (
+                lag_after.max() > 0
+                and int(np.argmax(lag_after)) not in members
+            ):
+                hier_laggiest_ok = False
+    hier_finite = bool(
+        np.isfinite(np.asarray(jax.device_get(losses))).all()
+    )
+    print(
+        "stale: two-tier leg (B=%d, K=2): tiers %s, straggler slice %s "
+        "coarsened as a unit, laggiest-in-slice %s, finite %s"
+        % (
+            hier_B, sorted(tiers), sorted(members), hier_laggiest_ok,
+            hier_finite,
+        ),
+        file=sys.stderr,
+    )
+
+    out = {
+        "metric": "stale_straggler_wallclock_penalty_pct",
+        "value": round(stale_penalty_pct, 2),
+        # done-bar: the straggler's tail off the critical path — the
+        # stale leg's straggled-round p50 vs the no-straggler baseline
+        "vs_baseline": (
+            round(stale_slow_p50 / base_p50, 3) if base_p50 else None
+        ),
+        "unit": "% straggled-round p50 wall-clock vs no-straggler "
+        "baseline",
+        "platform": jax.devices()[0].platform,
+        "workers": workers,
+        "tau": tau,
+        "batch": batch,
+        "rounds": rounds,
+        "stale_bound": B,
+        "discount": discount,
+        "straggler_worker": straggler,
+        "slow_rounds": slow,
+        "tail_s": round(tail_s, 3),
+        "tail_injected_s": round(tail_injected_s, 3),
+        "wallclock_saved_s": round(saved_s, 3),
+        "b0_bit_identical": b0_bit_identical,
+        "b0_flat_bit_identical": bool(b0_flat),
+        "b0_hier_bit_identical": bool(b0_hier),
+        "b0_identity_rounds": ident_rounds,
+        "baseline_round_ms_p50": round(base_p50, 2),
+        "sync_slow_round_ms_p50": round(sync_slow_p50, 2),
+        "stale_slow_round_ms_p50": round(stale_slow_p50, 2),
+        "sync_straggler_penalty_pct": round(sync_penalty_pct, 2),
+        "stale_straggler_penalty_pct": round(stale_penalty_pct, 2),
+        "forced_folds": forced_folds,
+        "max_staleness": max_staleness,
+        "staleness_gauge_straggler": staleness_gauge,
+        "final_loss": round(stale_loss, 4),
+        "sync_final_loss": round(sync_loss, 4),
+        "baseline_final_loss": round(baseline_loss, 4),
+        "loss_band": round(loss_band, 4),
+        "loss_band_ok": loss_band_ok,
+        "hier_stale_bound": hier_B,
+        "hier_rounds": hier_rounds,
+        "hier_tiers": sorted(tiers),
+        "hier_straggler_slice": sorted(members),
+        "hier_laggiest_ok": bool(hier_laggiest_ok),
+        "hier_finite": hier_finite,
+        "note": "cifar10_quick on the virtual CPU mesh.  Leg 1 pins "
+        "--stale_bound 0 BITWISE identical to the synchronous "
+        "ParameterAveragingTrainer round (flat and two-tier): the "
+        "degenerate path IS sync averaging.  Leg 2 is the straggler "
+        "A/B: one worker carries a +tail_s TRANSIENT tail for %d "
+        "consecutive rounds (MODELED as a sleep the waiting side pays "
+        "— the harness decides arrivals on the virtual mesh; the "
+        "PERF.md modeled-straggler convention).  The sync control "
+        "pays the tail at every straggled boundary; the B=%d leg "
+        "averages whoever arrived with staleness-discounted weights "
+        "(discount^lag), the straggler folds back in after the window "
+        "(%d bound-forced fold(s)), and the straggled rounds' p50 "
+        "sits on the no-straggler baseline.  Wall-clock numbers are "
+        "this CPU box's; the CLAIM gated is the penalty split (stale "
+        "~0, sync ~the tail) and the ONE-SIDED loss band (staleness "
+        "must not hurt convergence: the deficit-weighted discount "
+        "keeps a once-straggled worker permanently down-weighted, so "
+        "a long horizon can drift BELOW the sync control — drift, not "
+        "damage), both machine-relative.  The non-claim, stated: a "
+        "PERMANENT rate deficit pins lag at the bound and throttles "
+        "every boundary to the straggler — bounded staleness absorbs "
+        "tails, not a standing throughput gap.  Leg 3 runs the same straggler two-tier: "
+        "intra-slice boundaries stay synchronous inside arriving "
+        "slices, the straggler's slice goes stale as a COARSENED unit "
+        "(a slice arrives only when every live member did), and the "
+        "worker-round ledger still names its members laggiest."
+        % (K, B, forced_folds),
+    }
+    print(json.dumps(out))
+
+
 def bench_lm():
     """Transformer-LM workload proof (``models/transformer_lm.py`` +
     ``data/text.py`` riding the averaging stack).
@@ -4725,6 +5108,9 @@ def main():
         return
     if _MODE == "elastic":
         bench_elastic()
+        return
+    if _MODE == "stale":
+        bench_stale()
         return
     if _MODE == "recover":
         bench_recover()

@@ -113,6 +113,13 @@ CANONICAL_METRICS = {
     "sparknet_gen_decode_batch_occupancy": (),
     "sparknet_gen_jit_cache_size": (),
     "sparknet_gen_resumes_total": (),
+    # bounded-staleness averaging (parallel/stale.py, --stale_bound) —
+    # per-worker lag/arrival accounting at each averaging boundary
+    "sparknet_staleness": ("worker",),
+    "sparknet_stale_arrivals_total": ("worker",),
+    "sparknet_stale_skipped_total": ("worker",),
+    "sparknet_stale_forced_waits_total": (),
+    "sparknet_stale_boundaries_skipped_total": (),
     # fleet collector (obs/fleet.py, --fleet_collector) — the merged
     # cross-host families on the collector's own /metrics
     "sparknet_fleet_hosts": ("state",),

@@ -133,8 +133,12 @@ def main(argv=None) -> int:
     # chunked, optionally overlapped — parallel/comm.py);
     # --slices/--cross_slice_every: two-tier hierarchical schedule
     spec = hierarchy.spec_from_args(args, n_workers)
-    trainer = ParameterAveragingTrainer(
-        solver, mesh, **comm.comm_kwargs_from_args(args), hierarchy=spec
+    # --stale_bound: swap in the bounded-staleness trainer (same round
+    # surface; this app feeds every worker each round, so boundaries
+    # see full arrival sets — the flag matters for drivers that model
+    # arrivals, runtime/recover.py and the chaos harness)
+    trainer = hierarchy.averaging_trainer_from_args(
+        args, solver, mesh, n_workers, hierarchy=spec
     )
     # --elastic: the membership controller (runtime/membership.py)
     # maintains epoch-numbered roster views that drive each round's

@@ -73,11 +73,7 @@ def main(argv=None) -> int:
     from sparknet_tpu import models, runtime
     from sparknet_tpu.data import CifarLoader, RoundFeed, stack_windows
     from sparknet_tpu.io import caffemodel
-    from sparknet_tpu.parallel import (
-        ParameterAveragingTrainer,
-        make_mesh,
-        shard_leading,
-    )
+    from sparknet_tpu.parallel import make_mesh, shard_leading
     from sparknet_tpu.solver import Solver
     from sparknet_tpu.utils import TrainingLog
 
@@ -127,9 +123,8 @@ def main(argv=None) -> int:
             "--cross_slice_every hierarchy schedule; preemption "
             "masking rides the fleet plane)"
         )
-    trainer = ParameterAveragingTrainer(
-        solver, mesh, **comm.comm_kwargs_from_args(args),
-        **hierarchy.trainer_kwargs_from_args(args, args.workers),
+    trainer = hierarchy.averaging_trainer_from_args(
+        args, solver, mesh, args.workers
     )
     state = trainer.init_state(seed=args.seed)
     log.log("nets ready")

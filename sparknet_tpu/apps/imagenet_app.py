@@ -371,9 +371,8 @@ def main(argv=None) -> int:
             "--cross_slice_every hierarchy schedule; preemption "
             "masking rides the fleet plane)"
         )
-    trainer = ParameterAveragingTrainer(
-        solver, mesh, **comm.comm_kwargs_from_args(args),
-        **hierarchy.trainer_kwargs_from_args(args, n_workers),
+    trainer = hierarchy.averaging_trainer_from_args(
+        args, solver, mesh, n_workers
     )
     state = trainer.init_state(seed=args.seed)
     test_on_dev = shard_leading_global(test_batches, mesh)

@@ -94,7 +94,6 @@ def main(argv=None) -> int:
     from sparknet_tpu.data import RoundFeed, stack_windows
     from sparknet_tpu.io import caffemodel, checkpoint
     from sparknet_tpu.parallel import (
-        ParameterAveragingTrainer,
         first_worker,
         make_mesh,
         shard_leading,
@@ -213,9 +212,8 @@ def main(argv=None) -> int:
             "--cross_slice_every hierarchy schedule; preemption "
             "masking rides the fleet plane)"
         )
-    trainer = ParameterAveragingTrainer(
-        solver, mesh, **comm.comm_kwargs_from_args(args),
-        **hierarchy.trainer_kwargs_from_args(args, n_workers),
+    trainer = hierarchy.averaging_trainer_from_args(
+        args, solver, mesh, n_workers
     )
     state = trainer.init_state(seed=args.seed)
 

@@ -224,11 +224,7 @@ def main(argv=None) -> int:
     )
     from sparknet_tpu.io import checkpoint
     from sparknet_tpu.obs import health as health_mod
-    from sparknet_tpu.parallel import (
-        ParameterAveragingTrainer,
-        first_worker,
-        make_mesh,
-    )
+    from sparknet_tpu.parallel import first_worker, make_mesh
     from sparknet_tpu.utils import SignalHandler, SolverAction, TrainingLog
 
     sp = max(1, args.sp)
@@ -273,12 +269,9 @@ def main(argv=None) -> int:
     prefix = args.snapshot_prefix
     sentry = health_mod.sentry_from_args(args, solver, echo=log.log)
     spec = hierarchy.spec_from_args(args, n_workers)
-    trainer = ParameterAveragingTrainer(
-        solver,
-        mesh,
-        **comm.comm_kwargs_from_args(args),
-        hierarchy=spec,
-        batch_spec=lm_batch_spec(sp),
+    trainer = hierarchy.averaging_trainer_from_args(
+        args, solver, mesh, n_workers,
+        hierarchy=spec, batch_spec=lm_batch_spec(sp),
     )
     if sentry is not None and prefix:
         sentry.restore_fn = health_mod.make_restore_fn(

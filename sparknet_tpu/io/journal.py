@@ -210,15 +210,22 @@ class RunJournal:
           ``restore_newest_valid_journaled`` rewinds to.
         - ``commit_iter``: the committed boundary's iter (snapshots
           beyond it belong to uncommitted rounds and are ignored).
+        - ``worker_rounds``: the committed boundary's per-worker round
+          vector (bounded-staleness runs journal it on every record;
+          None for synchronous ledgers) — what a stale resume replays
+          from, <= stale_bound rounds.
         """
         last = self.last_committed_round
         snapshot = None
         commit_iter = None
+        worker_rounds = None
         for rec in reversed(self.records):
             if rec.get("kind") != COMMIT:
                 continue
             if commit_iter is None and "iter" in rec:
                 commit_iter = int(rec["iter"])
+            if worker_rounds is None and rec.get("worker_rounds"):
+                worker_rounds = [int(v) for v in rec["worker_rounds"]]
             if rec.get("snapshot"):
                 snapshot = str(rec["snapshot"])
                 break
@@ -228,6 +235,7 @@ class RunJournal:
             "resume_round": 0 if last is None else last + 1,
             "snapshot": snapshot,
             "commit_iter": commit_iter,
+            "worker_rounds": worker_rounds,
             "records": len(self.records),
             "truncated_bytes": self.truncated_bytes,
         }

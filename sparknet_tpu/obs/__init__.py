@@ -387,6 +387,40 @@ class TrainingMetrics:
             "parallelism: K+V shards x (sp-1) hops x layers, "
             "forward + transposed backward; zero when sp=1)",
         )
+        # bounded-staleness averaging series (parallel/stale.py,
+        # --stale_bound) — zero on the synchronous round
+        self.staleness = registry.gauge(
+            "sparknet_staleness",
+            "per-worker staleness at the last averaging boundary "
+            "(boundary index minus the worker's own round; 0 on the "
+            "synchronous path, bounded by --stale_bound otherwise)",
+            labels=("worker",),
+        )
+        self.stale_arrivals = registry.counter(
+            "sparknet_stale_arrivals_total",
+            "boundary fold-ins per worker (the arrival mask: the "
+            "worker's finished tau-window entered this boundary's "
+            "staleness-weighted mean)",
+            labels=("worker",),
+        )
+        self.stale_skipped = registry.counter(
+            "sparknet_stale_skipped_total",
+            "boundaries a worker sat out (window still in flight; its "
+            "contribution folds in at a later boundary instead of "
+            "stalling this one)",
+            labels=("worker",),
+        )
+        self.stale_forced_waits = registry.counter(
+            "sparknet_stale_forced_waits_total",
+            "arrivals forced by the staleness bound (a live worker hit "
+            "lag B and the boundary blocked for it — the bounded "
+            "synchronous cost; ~0 is the stale bench's win condition)",
+        )
+        self.stale_boundaries_skipped = registry.counter(
+            "sparknet_stale_boundaries_skipped_total",
+            "averaging boundaries skipped outright because no worker "
+            "had arrived (state untouched, no collective dispatched)",
+        )
 
 
 _lock = threading.Lock()

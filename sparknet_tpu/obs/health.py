@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import math
 import os
+from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -225,6 +226,13 @@ class HealthSentry:
         self._emvar = 0.0
         self._seen = 0
         self._cooldown = 0
+        # per-round EMA snapshots (bounded ring): the z-score lens AT a
+        # past round, so a bounded-staleness arrival is judged at its
+        # OWN round index — a lag-L worker's (legitimately higher) loss
+        # compared against round b's EMA would read as a spike
+        self._ema_ring: "OrderedDict[int, Tuple[Optional[float], float, int]]" = (
+            OrderedDict()
+        )
         # exported state (the /healthz surface)
         self.last_anomaly_round: Optional[int] = None
         # last round INDEX observed — resumed runs pass absolute
@@ -273,6 +281,13 @@ class HealthSentry:
             "emvar": self._emvar,
             "seen": self._seen,
             "cooldown": self._cooldown,
+            # the per-round lens ring (bounded-staleness judging): a
+            # resume that drops it would re-judge a replayed stale
+            # arrival with an empty lens
+            "ema_ring": {
+                str(r): [v[0], v[1], v[2]]
+                for r, v in self._ema_ring.items()
+            },
             "last_anomaly_round": self.last_anomaly_round,
             "last_round": self.last_round,
             "rounds_observed": self.rounds_observed,
@@ -285,6 +300,20 @@ class HealthSentry:
         self._emvar = float(d.get("emvar", 0.0))
         self._seen = int(d.get("seen", 0))
         self._cooldown = int(d.get("cooldown", 0))
+        self._ema_ring = OrderedDict(
+            (
+                int(r),
+                (
+                    None if v[0] is None else float(v[0]),
+                    float(v[1]),
+                    int(v[2]),
+                ),
+            )
+            for r, v in sorted(
+                (d.get("ema_ring") or {}).items(),
+                key=lambda kv: int(kv[0]),
+            )
+        )
         lar = d.get("last_anomaly_round")
         self.last_anomaly_round = None if lar is None else int(lar)
         lr = d.get("last_round")
@@ -301,13 +330,22 @@ class HealthSentry:
         return z > self.z_threshold
 
     def _zscore(self, loss: float) -> float:
-        if self._ema is None or self._seen < self.warmup_rounds:
+        return self._zscore_at(loss, (self._ema, self._emvar, self._seen))
+
+    def _zscore_at(self, loss: float, lens) -> float:
+        """z-score against an explicit (ema, emvar, seen) lens — the
+        current one, or a past round's snapshot from ``_ema_ring``
+        (bounded-staleness arrivals are judged at their OWN round)."""
+        if lens is None:
+            return 0.0
+        ema, emvar, seen = lens
+        if ema is None or seen < self.warmup_rounds:
             return 0.0
         # variance floor at 5% of the loss scale: with a near-constant
         # loss the EMA variance collapses and raw z would flag noise
-        sigma = math.sqrt(max(0.0, self._emvar))
-        denom = max(sigma, 0.05 * abs(self._ema) + 1e-8)
-        return (loss - self._ema) / denom
+        sigma = math.sqrt(max(0.0, emvar))
+        denom = max(sigma, 0.05 * abs(ema) + 1e-8)
+        return (loss - ema) / denom
 
     def _update_ema(self, loss: float) -> None:
         if not math.isfinite(loss):
@@ -329,10 +367,23 @@ class HealthSentry:
         self._seen = 0
 
     # ------------------------------------------------------------------
-    def observe(self, round_index: int, losses, stats) -> HealthVerdict:
+    def observe(
+        self, round_index: int, losses, stats, *,
+        arrived=None, worker_rounds=None,
+    ) -> HealthVerdict:
         """Classify one round from its losses + audit stats tree.  The
         (small, scalar-only) stats fetch is the audit's one deliberate
-        device->host sync per round."""
+        device->host sync per round.
+
+        Bounded-staleness boundaries (``parallel/stale.py``) pass
+        ``arrived`` (num_workers, bools: whose window folded in — the
+        others' losses/stats are zeroed in-graph and must not drag the
+        EMA) and ``worker_rounds`` (num_workers, ints: the absolute
+        round each worker's folded window BELONGS to).  Each stale
+        arrival is then judged against the EMA lens at its OWN round
+        (the ``_ema_ring`` snapshot), not the boundary's — a lag-L
+        worker's legitimately higher loss never trips a false
+        spike, while a genuinely divergent one still does."""
         import jax
 
         from sparknet_tpu import obs as _obs
@@ -351,7 +402,42 @@ class HealthSentry:
 
         host = jax.tree_util.tree_map(_get_local, stats)
         loss_arr = np.asarray(_get_local(losses), np.float64)
-        loss = float(np.mean(loss_arr)) if loss_arr.size else float("nan")
+        # arrival-aware loss view: the round-mean (and the EMA it
+        # feeds) covers CURRENT-round arrivals; stale arrivals are
+        # judged separately at their own round's lens below
+        arr_mask = None
+        wr = None
+        stale_z = 0.0
+        if (
+            arrived is not None
+            and loss_arr.ndim >= 2
+            and np.asarray(arrived).reshape(-1).shape[0]
+            == loss_arr.shape[0]
+        ):
+            arr_mask = np.asarray(arrived, bool).reshape(-1)
+            if worker_rounds is not None:
+                wr = np.asarray(worker_rounds, np.int64).reshape(-1)
+            fresh = (
+                arr_mask
+                if wr is None
+                else arr_mask & (wr >= round_index)
+            )
+            base = fresh if fresh.any() else arr_mask
+            sel = loss_arr[base] if base.any() else loss_arr[arr_mask]
+            loss = float(np.mean(sel)) if sel.size else float("nan")
+            if wr is not None:
+                for w in np.nonzero(arr_mask & (wr < round_index))[0]:
+                    lens = self._ema_ring.get(int(wr[w]))
+                    zw = self._zscore_at(
+                        float(np.mean(loss_arr[w])), lens
+                    )
+                    stale_z = max(stale_z, zw)
+        else:
+            loss = (
+                float(np.mean(loss_arr))
+                if loss_arr.size
+                else float("nan")
+            )
 
         def total(name) -> int:
             return int(np.sum(np.asarray(host.get(name, 0))))
@@ -384,12 +470,23 @@ class HealthSentry:
             reasons.append("nonfinite")
         if self._cooldown > 0:
             self._cooldown -= 1
-        elif self._spike(z):
+        elif self._spike(z) or self._spike(stale_z):
+            # z: current-round arrivals vs the live EMA; stale_z: each
+            # stale arrival vs the lens AT its own round — both real
+            # divergence signals, neither a staleness artifact
             reasons.append("loss_spike")
         v = HealthVerdict(
             round_index, loss, z, self._last_scalar(host, "grad_norm"),
             nf_grads, nf_params, nf_loss, per_worker, masked, reasons,
         )
+        # snapshot the pre-update lens for this round, then fold the
+        # loss in: a future lag-L arrival whose window was round r is
+        # judged against what the EMA was AT round r
+        self._ema_ring[int(round_index)] = (
+            self._ema, self._emvar, self._seen
+        )
+        while len(self._ema_ring) > 128:
+            self._ema_ring.popitem(last=False)
         self._update_ema(loss)
         self.last_round = round_index
         self.rounds_observed += 1

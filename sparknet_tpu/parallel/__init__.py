@@ -43,3 +43,9 @@ from sparknet_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
     ring_self_attention,
 )
+from sparknet_tpu.parallel.stale import (  # noqa: F401
+    BoundedStalenessTrainer,
+    export_worker_replicas,
+    restore_worker_replicas,
+    stale_window,
+)

@@ -150,6 +150,22 @@ def add_cli_args(parser) -> None:
         "only on external events — fleet views / note_join)",
     )
     parser.add_argument(
+        "--stale_bound", type=int, default=0,
+        help="bounded-staleness averaging (parallel/stale.py): let "
+        "workers run ahead up to B rounds; each boundary averages "
+        "whoever has arrived with staleness-discounted weights and a "
+        "live worker at lag B is forced in.  0 = today's synchronous "
+        "round, bit-identical (the degenerate-path pin).  With "
+        "--slices the hierarchy goes asymmetric: intra-slice sync "
+        "every round, lazy stale-tolerant cross-slice",
+    )
+    parser.add_argument(
+        "--stale_discount", type=float, default=0.5,
+        help="per-round staleness weight decay for --stale_bound > 0: "
+        "a lag-L arrival enters the boundary's weighted mean at "
+        "discount**L (1.0 = no discount; default 0.5)",
+    )
+    parser.add_argument(
         "--elastic", action="store_true",
         help="arm the elastic membership controller "
         "(runtime/membership.py): epoch-numbered views of the worker "
@@ -175,6 +191,41 @@ def trainer_kwargs_from_args(args, num_workers: int) -> dict:
     """Trainer kwargs for the hierarchy from parsed CLI args (the
     ``comm.comm_kwargs_from_args`` pattern)."""
     return {"hierarchy": spec_from_args(args, num_workers)}
+
+
+def stale_kwargs_from_args(args) -> dict:
+    """``BoundedStalenessTrainer`` kwargs from parsed CLI args, or an
+    empty dict when ``--stale_bound`` stays at the synchronous default
+    (the apps then construct the plain averaging trainer)."""
+    bound = int(getattr(args, "stale_bound", 0) or 0)
+    if bound <= 0:
+        return {}
+    return {
+        "stale_bound": bound,
+        "discount": float(getattr(args, "stale_discount", 0.5) or 0.5),
+    }
+
+
+def averaging_trainer_from_args(args, solver, mesh, num_workers, **extra):
+    """The round-averaging trainer the CLI flags describe: the plain
+    ``ParameterAveragingTrainer``, or — with ``--stale_bound > 0`` —
+    the ``BoundedStalenessTrainer`` wrapping it (same round surface;
+    the stale trainer itself rejects compress/overlap combinations).
+    Comm kwargs and the hierarchy spec are folded in from ``args``;
+    ``extra`` overrides (pass ``hierarchy=spec`` when the app already
+    built the spec for the membership controller)."""
+    from sparknet_tpu.parallel import comm as comm_mod
+    from sparknet_tpu.parallel.trainers import ParameterAveragingTrainer
+
+    kw = dict(comm_mod.comm_kwargs_from_args(args))
+    kw.update(extra)
+    kw.setdefault("hierarchy", spec_from_args(args, num_workers))
+    stale = stale_kwargs_from_args(args)
+    if stale:
+        from sparknet_tpu.parallel.stale import BoundedStalenessTrainer
+
+        return BoundedStalenessTrainer(solver, mesh, **kw, **stale)
+    return ParameterAveragingTrainer(solver, mesh, **kw)
 
 
 def slice_members(nprocs: int, num_slices: int) -> Tuple[Tuple[int, ...], ...]:

@@ -214,6 +214,25 @@ class FaultPlan:
     # SIGKILL sweep; the real kill-anywhere proof is ``bench.py
     # --mode=recover`` / RECOVER_r17.)
     driver_kill_round: Optional[int] = 5
+    # slow_slice: at the END of this round, a bounded A/B sub-scenario
+    # (parallel/stale.py): one whole slice of a two-tier job runs
+    # +slow_slice_s per round for slow_slice_rounds consecutive
+    # rounds.  The synchronous control (ParameterAveragingTrainer)
+    # waits for it at every boundary and pays the full tail straight
+    # onto the critical path; the bounded-staleness leg
+    # (BoundedStalenessTrainer, stale_bound > slow_slice_rounds) takes
+    # whoever arrived, lets the slow slice go stale, and folds it in
+    # after the tail clears.  Survived = the stale leg paid ZERO
+    # forced waits, its wall-clock undercuts the sync control by most
+    # of the injected tail, the per-worker staleness telemetry names a
+    # slow-slice member as the laggiest worker every slow round, and
+    # the two final losses agree within the band (the speed is not
+    # bought with divergence).
+    slow_slice_round: Optional[int] = 4
+    slow_slice_slice: int = 1
+    slow_slice_s: float = 0.5
+    slow_slice_rounds: int = 3
+    slow_slice_stale_bound: int = 4
 
     @classmethod
     def default(cls) -> "FaultPlan":
@@ -238,6 +257,7 @@ class FaultPlan:
             publish_corrupt_round=None,
             slice_preempt_round=None,
             driver_kill_round=None,
+            slow_slice_round=None,
         )
 
 
@@ -723,6 +743,191 @@ def _driver_kill_scenario(plan: FaultPlan, counters: Dict, note, workdir):
     }
 
 
+def _slow_slice_scenario(plan: FaultPlan, counters: Dict, note, workdir):
+    """The slow_slice fault: one whole slice runs ``+slow_slice_s`` per
+    round for ``slow_slice_rounds`` consecutive rounds, and the
+    question is what that tail COSTS.  Two bounded legs over the same
+    solver/mesh (a ``runtime/recover.py`` context, two-tier hierarchy):
+
+    - sync control (``ParameterAveragingTrainer``): every averaging
+      boundary waits for the slow slice, so the job pays the full
+      K x slow_s tail straight onto the critical path;
+    - stale leg (``BoundedStalenessTrainer``, bound > K): the boundary
+      takes whoever arrived; the slow slice goes stale (coarsened as a
+      unit) and folds in after its tail clears, so the harness never
+      sleeps on its behalf — the ONLY thing that can put the tail back
+      on the critical path is the bound forcing a still-slow worker.
+
+    Survived = zero forced waits in the stale leg, its measured
+    wall-clock undercuts the sync control by most of the injected
+    tail, the staleness ledger names a slow-slice member as the
+    laggiest worker on every slow round (the fleet side can still
+    point at the exact straggler), and the two final losses agree
+    within the band (the speed is not bought with divergence)."""
+    from sparknet_tpu.parallel import (
+        BoundedStalenessTrainer,
+        ParameterAveragingTrainer,
+        shard_leading,
+        stale_window,
+    )
+    from sparknet_tpu.parallel.hierarchy import HierarchySpec
+    from sparknet_tpu.runtime import recover as recover_mod
+
+    base = os.path.join(workdir, "slow_slice")
+    ctx = recover_mod.RecoverContext(
+        base, workers=plan.workers, tau=1, batch=plan.batch,
+        seed=plan.seed, compress="none",
+    )
+    spec = HierarchySpec.grouped(
+        plan.workers, plan.membership_slices,
+        cross_slice_every=plan.cross_slice_every,
+    )
+    slow_members = tuple(spec.slices[plan.slow_slice_slice])
+    K, slow_s = plan.slow_slice_rounds, plan.slow_slice_s
+    B = plan.slow_slice_stale_bound
+    rounds = max(6, K + 3)
+    slow_rounds = set(range(1, 1 + K))
+
+    counters["slow_slice_injected"] = 1
+    _obs.fault(
+        "slow_slice", slice=plan.slow_slice_slice,
+        workers=list(slow_members), tail_s=slow_s, rounds=K,
+    )
+    note(
+        "slow_slice: slice %d (workers %s) +%.2fs/round for rounds %s "
+        "— sync control vs stale_bound=%d A/B"
+        % (plan.slow_slice_slice, list(slow_members), slow_s,
+           sorted(slow_rounds), B)
+    )
+
+    def leg(stale_bound: int) -> Dict:
+        if stale_bound > 0:
+            trainer = BoundedStalenessTrainer(
+                ctx.solver, ctx.mesh, stale_bound=stale_bound,
+                hierarchy=spec,
+            )
+        else:
+            trainer = ParameterAveragingTrainer(
+                ctx.solver, ctx.mesh, hierarchy=spec
+            )
+        state = trainer.init_state(seed=ctx.seed)
+        tail_paid_s = 0.0
+        forced_waits = 0
+        laggiest = []
+        last_losses = None
+        compute_s = []  # per-round wall-clock minus this round's sleeps
+        slept_s = 0.0
+        for r in range(rounds):
+            slow_now = r in slow_rounds
+            slept_before = tail_paid_s
+            t0 = time.perf_counter()
+            if stale_bound > 0:
+                arrived = np.ones((plan.workers,), bool)
+                if slow_now:
+                    arrived[list(slow_members)] = False
+                    lag = trainer.lags(r)
+                    if int(lag[list(slow_members)].max()) >= stale_bound:
+                        # a forced arrival of a still-slow worker: the
+                        # bound puts the tail back on the critical path
+                        forced_waits += 1
+                        tail_paid_s += slow_s
+                        time.sleep(slow_s)
+                state, losses, _ = trainer.round(
+                    state,
+                    shard_leading(
+                        stale_window(ctx.batch_for, trainer.worker_rounds),
+                        ctx.mesh,
+                    ),
+                    arrived=arrived, round_index=r,
+                )
+                if slow_now:
+                    # post-round attribution: the ledger's laggiest
+                    # worker must be a slow-slice member
+                    laggiest.append(int(np.argmax(trainer.lags(r + 1))))
+            else:
+                if slow_now:
+                    # the synchronous boundary cannot proceed without
+                    # the slow slice: the whole job eats the tail
+                    tail_paid_s += slow_s
+                    time.sleep(slow_s)
+                state, losses, _ = trainer.round(
+                    state, shard_leading(ctx.batch_for(r), ctx.mesh),
+                    round_index=r,
+                )
+            losses = np.asarray(losses)
+            if r > 0:  # round 0 carries the jit compile
+                dt = time.perf_counter() - t0
+                round_slept = tail_paid_s - slept_before
+                compute_s.append(dt - round_slept)
+                slept_s += round_slept
+            last_losses = losses
+        # One shared CPU core and a possible mid-leg recompile or GC
+        # pause can put a one-off multi-hundred-ms spike on a single
+        # round and swamp the A/B; trim each leg's single worst compute
+        # round (symmetric across legs) and add the sleeps back exactly.
+        trimmed = sorted(compute_s)[:-1] if len(compute_s) > 1 else (
+            compute_s
+        )
+        elapsed = sum(trimmed) + slept_s
+        finite = last_losses[np.isfinite(last_losses)]
+        return {
+            "elapsed_s": round(elapsed, 3),
+            "tail_paid_s": round(tail_paid_s, 3),
+            "forced_waits": forced_waits,
+            "final_loss": round(float(np.mean(finite)), 4),
+            "laggiest_by_slow_round": laggiest,
+        }
+
+    sync = leg(0)
+    stale = leg(B)
+    tail_injected_s = K * slow_s
+    saved_s = sync["elapsed_s"] - stale["elapsed_s"]
+    named_ok = bool(stale["laggiest_by_slow_round"]) and all(
+        w in slow_members for w in stale["laggiest_by_slow_round"]
+    )
+    band = max(0.5, 0.5 * abs(sync["final_loss"]))
+    loss_band_ok = (
+        abs(stale["final_loss"] - sync["final_loss"]) <= band
+    )
+    survived = bool(
+        stale["forced_waits"] == 0
+        and sync["tail_paid_s"] >= tail_injected_s - 1e-9
+        and saved_s >= 0.6 * tail_injected_s
+        and named_ok
+        and loss_band_ok
+    )
+    if survived:
+        counters["slow_slice_survived"] = 1
+        note(
+            "slow_slice survived: stale leg paid 0 forced waits and "
+            "saved %.2fs of the %.2fs injected tail (sync control ate "
+            "all of it); laggiest worker named in %s every slow round; "
+            "final losses %.4f vs %.4f within band %.4f"
+            % (saved_s, tail_injected_s, list(slow_members),
+               stale["final_loss"], sync["final_loss"], band)
+        )
+        _obs.instant(
+            "stale_absorbed_tail", kind="slow_slice",
+            saved_s=round(saved_s, 3),
+        )
+    return {
+        "slice": plan.slow_slice_slice,
+        "workers": list(slow_members),
+        "tail_s_per_round": slow_s,
+        "slow_rounds": sorted(slow_rounds),
+        "stale_bound": B,
+        "rounds": rounds,
+        "tail_injected_s": round(tail_injected_s, 3),
+        "sync": sync,
+        "stale": stale,
+        "wallclock_saved_s": round(saved_s, 3),
+        "straggler_named_ok": named_ok,
+        "loss_band": round(band, 4),
+        "loss_band_ok": loss_band_ok,
+        "survived": survived,
+    }
+
+
 def run_kill_sweep(
     workdir: Optional[str] = None,
     rounds: int = 4,
@@ -760,7 +965,14 @@ def run_kill_sweep(
 
     from sparknet_tpu.runtime import recover as recover_mod
 
-    kill_points = tuple(kill_points or recover_mod.KILL_POINTS)
+    # stale_boundary only exists on a --stale_bound > 0 driver — the
+    # dedicated stale leg below kills it under the right flags; in the
+    # synchronous sweep the child would refuse the phase at argparse
+    kill_points = tuple(
+        kp
+        for kp in (kill_points or recover_mod.KILL_POINTS)
+        if kp != "stale_boundary"
+    )
     workdir = workdir or tempfile.mkdtemp(prefix="recover_sweep_")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     base_args = [
@@ -877,6 +1089,72 @@ def run_kill_sweep(
            "unexpectedly matched")
     )
 
+    # the bounded-staleness leg: the SAME SIGKILL discipline on an
+    # async driver (--stale_bound), killed at the stale_boundary phase
+    # — the arrival set has folded and the worker-round ledger advanced
+    # in memory, but neither the snapshot nor the commit record landed.
+    # Resume must rewind to the journaled per-worker round vector and
+    # replay at most stale_bound rounds, bit-identically against an
+    # uninterrupted stale control.
+    stale_bound = 2
+    stale_args = ("--stale_bound", str(stale_bound))
+    say(f"stale control run (stale_bound={stale_bound}, no kill)")
+    rc, stale_control, _ = child(
+        os.path.join(workdir, "stale_control"), *stale_args
+    )
+    if rc != 0:
+        raise RuntimeError(f"stale recover control failed (rc {rc})")
+    wd = os.path.join(workdir, "kill_stale_boundary")
+    say(f"SIGKILL at stale_boundary:{kill_round} -> resume")
+    rc1, _, _ = child(
+        wd, *stale_args, "--kill_at", f"stale_boundary:{kill_round}"
+    )
+    rc2, srec, _ = child(wd, *stale_args, "--resume")
+    stale_replayed = (
+        len([r for r in srec["rounds_executed"] if r <= kill_round])
+        if srec else None
+    )
+    stale_row = {
+        "kill_at": f"stale_boundary:{kill_round}",
+        "stale_bound": stale_bound,
+        "killed": rc1 != 0,
+        "resumed_rc": rc2,
+        "bit_identical": bool(
+            srec
+            and srec["final_digest"] == stale_control["final_digest"]
+        ),
+        "replayed_rounds": stale_replayed,
+        "recovery_latency_s": srec["restore_s"] if srec else None,
+        "start_round": srec["start_round"] if srec else None,
+        "journal_truncated_bytes": (
+            srec["journal_truncated_bytes"] if srec else None
+        ),
+        "resumed_worker_rounds": (
+            (srec.get("resume_info") or {}).get("worker_rounds")
+            if srec else None
+        ),
+        "final_worker_rounds": (
+            srec.get("worker_rounds") if srec else None
+        ),
+    }
+    stale_row["survived"] = bool(
+        stale_row["killed"]
+        and rc2 == 0
+        and stale_row["bit_identical"]
+        and stale_replayed is not None
+        and stale_replayed <= stale_bound
+    )
+    say(
+        "stale_boundary:%d %s (replayed %s <= bound %d, latency %ss)"
+        % (
+            kill_round,
+            "SURVIVED bit-identical" if stale_row["survived"] else
+            "FAILED " + _json.dumps(stale_row),
+            stale_row["replayed_rounds"], stale_bound,
+            stale_row["recovery_latency_s"],
+        )
+    )
+
     def p50(xs):
         s = sorted(xs)
         return s[len(s) // 2] if s else None
@@ -906,6 +1184,8 @@ def run_kill_sweep(
             default=None,
         ),
         "control_digest": control["final_digest"],
+        "stale": stale_row,
+        "stale_control_digest": stale_control["final_digest"],
         "no_journal_diverged": no_journal_diverged,
         "no_journal_digest": njrec["final_digest"] if njrec else None,
         "journal_bit_neutral": bool(
@@ -1489,6 +1769,18 @@ def run_chaos(
             counters["driver_kill_summary"] = _driver_kill_scenario(
                 plan, counters, note, workdir
             )
+        if (
+            plan.slow_slice_round is not None
+            and r == plan.slow_slice_round
+            and not counters.get("slow_slice_injected")
+        ):
+            # bounded-staleness fault: a whole slice +X s/round — the
+            # sync control pays the full tail, the stale leg doesn't,
+            # and the ledger still names the straggler (fires once;
+            # bounded A/B sub-scenario like driver_kill)
+            counters["slow_slice_summary"] = _slow_slice_scenario(
+                plan, counters, note, workdir
+            )
         if membership_ctl is not None:
             if (
                 r == plan.slice_preempt_round
@@ -1727,6 +2019,9 @@ def run_chaos(
         "driver_kill": (
             "driver_kill_injected", "driver_kill_survived",
         ),
+        "slow_slice": (
+            "slow_slice_injected", "slow_slice_survived",
+        ),
     }
     faults = {
         kind: {
@@ -1763,6 +2058,8 @@ def run_chaos(
         "publish_corrupt_round": plan.publish_corrupt_round,
         "driver_kill_round": plan.driver_kill_round,
         "driver_kill": counters.get("driver_kill_summary"),
+        "slow_slice_round": plan.slow_slice_round,
+        "slow_slice": counters.get("slow_slice_summary"),
         "slice_preempt_round": plan.slice_preempt_round,
         "slice_preempt_slice": plan.slice_preempt_slice,
         "slice_leave_round": counters.get("slice_leave_round"),

@@ -63,6 +63,11 @@ KILL_POINTS = (
     "average",
     "snapshot_mid_write",
     "journal_mid_append",
+    # bounded-staleness runs only (--stale_bound > 0): fires right
+    # after the stale averaging boundary folded its arrival set, before
+    # the worker-round vector is committed — resume must replay the
+    # boundary from the journaled vector, <= stale_bound rounds
+    "stale_boundary",
 )
 
 
@@ -101,12 +106,14 @@ class RecoverContext:
         batch: int = 8,
         seed: int = 7,
         compress: str = "int8",
+        stale_bound: int = 0,
     ):
         import jax
 
         from sparknet_tpu import config as cfg, models
         from sparknet_tpu.data import CifarLoader
         from sparknet_tpu.parallel import (
+            BoundedStalenessTrainer,
             ParameterAveragingTrainer,
             make_mesh,
         )
@@ -117,6 +124,12 @@ class RecoverContext:
         self.tau = tau
         self.batch = batch
         self.seed = seed
+        self.stale_bound = int(stale_bound)
+        if self.stale_bound > 0:
+            # stale boundaries don't compose with the comm plane's
+            # EF-residual collectives; the stale recovery leg carries
+            # the worker-round ledger + per-worker replicas instead
+            compress = "none"
         self.compress = compress
         os.makedirs(workdir, exist_ok=True)
         data_dir = os.path.join(workdir, "data")
@@ -145,9 +158,20 @@ class RecoverContext:
         self.mesh = make_mesh(
             {"dp": workers}, devices=jax.devices()[:workers]
         )
-        self.trainer = ParameterAveragingTrainer(
-            self.solver, self.mesh, compress=compress
-        )
+        if self.stale_bound > 0:
+            self.trainer = BoundedStalenessTrainer(
+                self.solver, self.mesh, stale_bound=self.stale_bound
+            )
+            # the deterministic straggler: the last worker never
+            # self-arrives, so every boundary's arrival set is a pure
+            # function of the (journaled) worker-round vector — the
+            # bound forces it in every stale_bound-th boundary
+            self.straggler = workers - 1
+        else:
+            self.trainer = ParameterAveragingTrainer(
+                self.solver, self.mesh, compress=compress
+            )
+            self.straggler = None
         self.prefix = os.path.join(workdir, "recover_ckpt")
 
     def batch_for(self, r: int) -> Dict[str, np.ndarray]:
@@ -170,12 +194,22 @@ class RecoverContext:
 
         return HealthSentry(policy="warn")
 
+    def arrival_for(self) -> np.ndarray:
+        """The boundary's self-arrival set (pure: same every round —
+        the straggler's fold-ins come from the bound forcing it)."""
+        arr = np.ones((self.workers,), bool)
+        if self.straggler is not None:
+            arr[self.straggler] = False
+        return arr
 
-def state_digest(state, comm_state=None, sentry_state=None) -> str:
+
+def state_digest(
+    state, comm_state=None, sentry_state=None, stale_state=None
+) -> str:
     """Deterministic digest of the FULL job state: every TrainState
-    leaf (params, stats, history, iter), the comm plane's EF residuals
-    and the sentry's EMA scalars.  Bit-identity of two runs == equal
-    digests."""
+    leaf (params, stats, history, iter), the comm plane's EF residuals,
+    the sentry's EMA scalars, and (stale runs) the worker-round
+    ledger.  Bit-identity of two runs == equal digests."""
     import jax
 
     h = hashlib.sha256()
@@ -195,6 +229,21 @@ def state_digest(state, comm_state=None, sentry_state=None) -> str:
                 {
                     k: sentry_state.get(k)
                     for k in ("ema", "emvar", "seen", "cooldown")
+                },
+                sort_keys=True,
+            ).encode()
+        )
+    if stale_state is not None:
+        h.update(
+            json.dumps(
+                {
+                    "boundary": int(np.asarray(stale_state["boundary"])),
+                    "worker_rounds": [
+                        int(v)
+                        for v in np.asarray(
+                            stale_state["worker_rounds"]
+                        ).reshape(-1)
+                    ],
                 },
                 sort_keys=True,
             ).encode()
@@ -226,15 +275,23 @@ def run_driver(
     from sparknet_tpu.io.journal import RunJournal, default_journal_path
     from sparknet_tpu.parallel import (
         export_worker_history,
+        export_worker_replicas,
         first_worker,
         restore_worker_history,
+        restore_worker_replicas,
         shard_leading,
+        stale_window,
     )
     from sparknet_tpu.parallel.hierarchy import HierarchySpec
     from sparknet_tpu.runtime import membership as membership_mod
 
     kill = kill or sigkill_self
     kp, kr = kill_at or (None, -1)
+    stale = ctx.stale_bound > 0
+    if kp == "stale_boundary" and not stale:
+        raise ValueError(
+            "kill_at stale_boundary needs a --stale_bound > 0 context"
+        )
 
     def maybe_kill(phase: str, r: int) -> None:
         if kp == phase and r == kr:
@@ -306,12 +363,28 @@ def run_driver(
                         state = restore_worker_history(
                             state, js["workers"], ctx.mesh
                         )
+                    if stale and "stale" in js:
+                        # bounded staleness: worker replicas DIVERGE
+                        # between boundaries (absent workers keep their
+                        # own params), so the full per-worker stacks
+                        # replace the broadcast consensus, and the
+                        # worker-round ledger resumes where it was
+                        state = restore_worker_replicas(
+                            state, js["stale"]["replicas"], ctx.mesh
+                        )
+                        ctx.trainer.load_stale_state(
+                            js["stale"]["ledger"]
+                        )
             else:
                 trainer.reset_comm_state()
+                if stale:
+                    trainer.reset_stale_state()
                 state = trainer.init_state(seed=ctx.seed)
             restore_s = time.perf_counter() - t0
         else:
             trainer.reset_comm_state()
+            if stale:
+                trainer.reset_stale_state()
             state = trainer.init_state(seed=ctx.seed)
 
         rounds_executed: List[int] = []
@@ -320,6 +393,18 @@ def run_driver(
         for r in range(start_round, rounds):
             t_r = time.perf_counter()
             view = membership.advance(r)
+            meta = {}
+            if stale:
+                # the journal VERSIONS every worker's round vector: the
+                # intent records what each worker was about to fold,
+                # the commit (below) what it folded — resume replays
+                # <= stale_bound rounds from exactly this vector
+                meta = {
+                    "worker_rounds": [
+                        int(v) for v in trainer.worker_rounds
+                    ],
+                    "stale_bound": ctx.stale_bound,
+                }
             if jr is not None:
                 # the WRITE-AHEAD intent: everything restart needs to
                 # know what round ``r`` was (the exactly-once bracket)
@@ -329,17 +414,43 @@ def run_driver(
                     view_epoch=view.epoch,
                     cursor=r,
                     rng="default_train_key(0)",
+                    **meta,
                 )
-            host = ctx.batch_for(r)
+            if stale:
+                # each worker consumes the window of its OWN next
+                # round — a pure function of the journaled ledger
+                host = stale_window(ctx.batch_for, trainer.worker_rounds)
+            else:
+                host = ctx.batch_for(r)
             maybe_kill("assemble", r)
             placed = shard_leading(host, ctx.mesh)
             maybe_kill("h2d", r)
-            state, losses, stats = trainer.round(
-                state, placed, round_index=r
-            )
+            if stale:
+                state, losses, stats = trainer.round(
+                    state, placed, arrived=ctx.arrival_for(),
+                    round_index=r,
+                )
+            else:
+                state, losses, stats = trainer.round(
+                    state, placed, round_index=r
+                )
             rounds_executed.append(r)
             maybe_kill("execute", r)
-            sentry.observe(r, losses, stats)
+            if stale:
+                # the mid-async-boundary preemption: the arrival set
+                # folded and the ledger advanced in memory, but neither
+                # the snapshot nor the commit record landed
+                maybe_kill("stale_boundary", r)
+                lb = trainer.last_boundary
+                sentry.observe(
+                    r, losses, stats,
+                    arrived=lb["arrived"],
+                    worker_rounds=[
+                        lb["boundary"] - l for l in lb["lag"]
+                    ],
+                )
+            else:
+                sentry.observe(r, losses, stats)
             maybe_kill("average", r)
             # the durable boundary: full job state beside params, then
             # the commit record referencing it
@@ -356,6 +467,14 @@ def run_driver(
             comm_state = trainer.export_comm_state()
             if comm_state is not None:
                 extra["comm"] = comm_state
+            if stale:
+                # full per-worker replicas + the ledger: stale worker
+                # states diverge by design, so the consensus snapshot
+                # under-determines the fleet
+                extra["stale"] = {
+                    "ledger": trainer.export_stale_state(),
+                    "replicas": export_worker_replicas(host_state),
+                }
             if kp == "snapshot_mid_write" and r == kr:
                 # the preemption lands while the solverstate tmp is
                 # written but unpublished — restore must never see it
@@ -376,15 +495,23 @@ def run_driver(
             if jr is not None:
                 if kp == "journal_mid_append" and r == kr:
                     jr.crash_hook = kill
+                commit_meta = dict(meta)
+                if stale:
+                    # post-fold vector: what the boundary durably owns
+                    commit_meta["worker_rounds"] = [
+                        int(v) for v in trainer.worker_rounds
+                    ]
                 jr.commit_round(
                     r,
                     iter=(r + 1) * ctx.tau,
                     snapshot=os.path.basename(state_path),
+                    **commit_meta,
                 )
             round_ms.append((time.perf_counter() - t_r) * 1e3)
 
         final_comm = trainer.export_comm_state()
         final_sentry = sentry.export_state()
+        final_stale = trainer.export_stale_state() if stale else None
         return {
             "rounds": rounds,
             "start_round": start_round,
@@ -392,7 +519,9 @@ def run_driver(
             "final_iter": int(
                 np.asarray(jax.device_get(state.iter)).reshape(-1)[0]
             ),
-            "final_digest": state_digest(state, final_comm, final_sentry),
+            "final_digest": state_digest(
+                state, final_comm, final_sentry, final_stale
+            ),
             "final_loss": (
                 float(np.mean(np.asarray(jax.device_get(losses))))
                 if losses is not None
@@ -406,6 +535,12 @@ def run_driver(
             ),
             "resumed_from": resumed_from,
             "resume_info": info,
+            "stale_bound": ctx.stale_bound,
+            "worker_rounds": (
+                [int(v) for v in trainer.worker_rounds]
+                if stale
+                else None
+            ),
             "restore_s": (
                 round(restore_s, 4) if restore_s is not None else None
             ),
@@ -427,6 +562,13 @@ def main(argv=None) -> int:
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--seed", type=int, default=7)
     p.add_argument("--compress", default="int8")
+    p.add_argument(
+        "--stale_bound", type=int, default=0,
+        help="run the bounded-staleness driver leg: the last worker "
+        "straggles (never self-arrives; the bound forces it), the "
+        "journal versions the worker-round vector, snapshots carry "
+        "full per-worker replicas.  0 = the synchronous driver",
+    )
     p.add_argument(
         "--kill_at", default=None, metavar="PHASE:ROUND",
         help="SIGKILL self at this phase boundary of this round "
@@ -455,6 +597,7 @@ def main(argv=None) -> int:
         batch=args.batch,
         seed=args.seed,
         compress=args.compress,
+        stale_bound=args.stale_bound,
     )
     rec = run_driver(
         ctx,

@@ -126,6 +126,7 @@ def test_unknown_mode_rejected():
     assert "recover" in out.stderr  # ... and the crash-consistency mode
     assert "|lm" in out.stderr  # ... and the transformer-LM mode
     assert "genserve" in out.stderr  # ... and the generation-serving mode
+    assert "stale" in out.stderr  # ... and the bounded-staleness mode
     # env-var route rejects identically
     out = subprocess.run(
         [sys.executable, os.path.join(_REPO, "bench.py")],
@@ -515,11 +516,12 @@ _CHAOS_SCHEMA_KEYS = (
     "cache_stats", "collector_outage", "slice_preempt_round",
     "slice_leave_round", "slice_rejoin_round", "slice_masked_rounds",
     "membership", "driver_kill_round", "driver_kill",
+    "slow_slice_round", "slow_slice",
 )
 
 
 def test_committed_chaos_artifact_schema():
-    """CHAOS_r17.json — the fault-tolerance committed artifact: every
+    """CHAOS_r20.json — the fault-tolerance committed artifact: every
     injected fault survived (the ISSUE 2 done-bar), every fault CLASS
     fired — including the round-12 data-plane faults (cache entry
     corrupted -> quarantined + refetched; cache wiped cold ->
@@ -530,13 +532,16 @@ def test_committed_chaos_artifact_schema():
     rejected at CRC verify, never canaried), the round-16 slice
     preemption (a whole slice SIGTERM'd, departing at exactly the next
     round boundary, training masked, rejoining via snapshot ->
-    broadcast), and the round-17 driver_kill (a journaled mini-driver
+    broadcast), the round-17 driver_kill (a journaled mini-driver
     crashed mid-commit-append, torn ledger truncated, recovery
-    BIT-IDENTICAL with at most one replayed round) — the run resumed
+    BIT-IDENTICAL with at most one replayed round), and the round-4
+    slow_slice (a whole slice +0.5s/round for a transient window: the
+    sync control pays the tail, the bounded-staleness leg absorbs it
+    with zero forced waits and names the straggler) — the run resumed
     from an OLDER verified snapshot after the newest was
     corrupted+quarantined, and the final loss sat inside the no-fault
     run's band."""
-    with open(os.path.join(_REPO, "CHAOS_r17.json")) as f:
+    with open(os.path.join(_REPO, "CHAOS_r20.json")) as f:
         d = json.load(f)
     for key in _CHAOS_SCHEMA_KEYS:
         assert key in d, key
@@ -550,7 +555,7 @@ def test_committed_chaos_artifact_schema():
         "dead_worker", "nan_injection", "straggler_injection",
         "cache_corruption", "cache_cold", "collector_outage",
         "replica_death", "published_snapshot_corrupt",
-        "slice_preemption", "driver_kill",
+        "slice_preemption", "driver_kill", "slow_slice",
     ):
         v = d["faults"][kind]
         assert v["injected"] >= 1, kind
@@ -560,6 +565,20 @@ def test_committed_chaos_artifact_schema():
     assert dk["journal_truncated_bytes"] > 0
     assert dk["replayed_rounds"] <= 1
     assert dk["resumed_digest"] == dk["control_digest"]
+    # the slow_slice A/B: the sync control really paid the injected
+    # tail, the stale leg paid zero forced waits and saved most of the
+    # wall-clock, the ledger named a slow-slice member laggiest on
+    # every slow round, and the speed was not bought with divergence
+    ss = d["slow_slice"]
+    assert ss["survived"] is True and ss["straggler_named_ok"] is True
+    assert ss["stale"]["forced_waits"] == 0
+    assert ss["sync"]["tail_paid_s"] >= ss["tail_injected_s"] - 1e-9
+    assert ss["wallclock_saved_s"] >= 0.6 * ss["tail_injected_s"]
+    assert ss["loss_band_ok"] is True
+    assert ss["slow_rounds"] and ss["stale_bound"] > max(
+        len(ss["slow_rounds"]), 1
+    )
+    assert set(ss["stale"]["laggiest_by_slow_round"]) <= set(ss["workers"])
     # the slice preemption's leave landed at EXACTLY the boundary after
     # the SIGTERM, the masked rounds cover the departed span, and the
     # final membership view is fully live again
@@ -1131,20 +1150,24 @@ _RECOVER_SCHEMA_KEYS = (
     "bit_identical_all", "max_replayed_rounds", "control_digest",
     "no_journal_diverged", "no_journal_digest", "journal_bit_neutral",
     "journal_round_ms_p50", "nojournal_round_ms_p50",
-    "journal_overhead_pct", "note",
+    "journal_overhead_pct", "stale", "stale_control_digest", "note",
 )
 
 
 def test_committed_recover_artifact_schema():
-    """RECOVER_r17.json — the crash-consistency committed artifact
+    """RECOVER_r20.json — the crash-consistency committed artifact
     (ISSUE 14 done-bars): a REAL SIGKILL at every phase boundary of
     the journaled driver (assemble, h2d, execute, average,
     snapshot-mid-write, journal-append-mid-record), each resumed
     BIT-IDENTICALLY to the uninterrupted control with at most one
     replayed round; the --no_journal kill+resume DIVERGED (the zero is
     not vacuous); the ledger itself is bit-neutral and its overhead
-    sits inside the noise floor."""
-    with open(os.path.join(_REPO, "RECOVER_r17.json")) as f:
+    sits inside the noise floor.  The ISSUE 17 extension rides along:
+    a SIGKILL at the mid-async ``stale_boundary`` of a
+    ``--stale_bound 2`` run resumes bit-identically with at most
+    stale_bound replayed rounds (the journaled worker_rounds vector is
+    the resume's replay cursor)."""
+    with open(os.path.join(_REPO, "RECOVER_r20.json")) as f:
         d = json.load(f)
     for key in _RECOVER_SCHEMA_KEYS:
         assert key in d, key
@@ -1156,7 +1179,12 @@ def test_committed_recover_artifact_schema():
     assert d["vs_baseline"] == 1.0
     from sparknet_tpu.runtime.recover import KILL_POINTS
 
+    # the synchronous sweep seeds every phase EXCEPT stale_boundary
+    # (that phase only exists on a --stale_bound > 0 driver — the
+    # dedicated stale leg below covers it); together they cover the
+    # full KILL_POINTS surface
     seeded = {row["kill_at"].split(":")[0] for row in d["killpoints"]}
+    seeded |= {d["stale"]["kill_at"].split(":")[0]}
     assert seeded == set(KILL_POINTS)  # every phase boundary covered
     for row in d["killpoints"]:
         assert row["killed"] is True, row  # the SIGKILL really landed
@@ -1184,6 +1212,105 @@ def test_committed_recover_artifact_schema():
     assert d["journal_bit_neutral"] is True
     assert d["journal_overhead_pct"] < 3.0
     assert "noise" in d["note"].lower()
+    # the stale leg: SIGKILL mid-async-boundary, bit-identical resume,
+    # replay bounded by the staleness bound (not by 1 — the averaging
+    # is allowed to be B rounds behind the fastest worker)
+    st = d["stale"]
+    assert st["killed"] is True and st["resumed_rc"] == 0
+    assert st["survived"] is True and st["bit_identical"] is True
+    assert st["kill_at"].startswith("stale_boundary")
+    assert 0 <= st["replayed_rounds"] <= st["stale_bound"]
+    assert st["stale_bound"] >= 1
+    assert st["resumed_worker_rounds"] is not None
+    assert d["stale_control_digest"]
+
+
+@pytest.mark.slow
+def test_stale_mode_smoke():
+    """bench.py --mode=stale end to end in a subprocess, trimmed to a
+    short run (the committed artifact pins the full 20-round sweep):
+    B=0 bit-identity must hold, the straggled rounds' p50 must sit
+    near the no-straggler baseline with zero forced folds, and the
+    two-tier leg must coarsen the straggler's slice."""
+    rec = _run_bench(
+        {"BENCH_MODE": "stale", "BENCH_STALE_ROUNDS": "8"},
+        timeout=1200,
+    )
+    assert rec["metric"] == "stale_straggler_wallclock_penalty_pct"
+    assert rec["b0_bit_identical"] is True
+    assert rec["b0_flat_bit_identical"] is True
+    assert rec["b0_hier_bit_identical"] is True
+    assert rec["forced_folds"] == 0
+    assert rec["stale_straggler_penalty_pct"] < (
+        rec["sync_straggler_penalty_pct"]
+    )
+    assert rec["loss_band_ok"] is True
+    assert rec["hier_laggiest_ok"] is True and rec["hier_finite"] is True
+
+
+_STALE_SCHEMA_KEYS = (
+    "metric", "value", "unit", "vs_baseline", "platform", "workers",
+    "tau", "batch", "rounds", "stale_bound", "discount",
+    "straggler_worker", "slow_rounds", "tail_s", "tail_injected_s",
+    "wallclock_saved_s", "b0_bit_identical", "b0_flat_bit_identical",
+    "b0_hier_bit_identical", "b0_identity_rounds",
+    "baseline_round_ms_p50", "sync_slow_round_ms_p50",
+    "stale_slow_round_ms_p50", "sync_straggler_penalty_pct",
+    "stale_straggler_penalty_pct", "forced_folds", "max_staleness",
+    "staleness_gauge_straggler", "final_loss", "sync_final_loss",
+    "baseline_final_loss", "loss_band", "loss_band_ok",
+    "hier_stale_bound", "hier_rounds", "hier_tiers",
+    "hier_straggler_slice", "hier_laggiest_ok", "hier_finite", "note",
+)
+
+
+def test_committed_stale_artifact_schema():
+    """STALE_r20.json — the bounded-staleness committed artifact
+    (ISSUE 17 done-bars): --stale_bound 0 BITWISE identical to the
+    synchronous round (flat and two-tier), the transient-straggler A/B
+    where the sync control pays the tail at every straggled boundary
+    while the stale leg's straggled-round p50 sits near the
+    no-straggler baseline with ZERO bound-forced folds, the one-sided
+    loss band (staleness must not hurt convergence), and the two-tier
+    leg coarsening the straggler's slice with the ledger naming its
+    members laggiest."""
+    with open(os.path.join(_REPO, "STALE_r20.json")) as f:
+        d = json.load(f)
+    for key in _STALE_SCHEMA_KEYS:
+        assert key in d, key
+    assert d["metric"] == "stale_straggler_wallclock_penalty_pct"
+    assert d["value"] == d["stale_straggler_penalty_pct"]
+    assert d["platform"] == "cpu"
+    # the degenerate-path pin: B=0 IS the synchronous round
+    assert d["b0_bit_identical"] is True
+    assert d["b0_flat_bit_identical"] is True
+    assert d["b0_hier_bit_identical"] is True
+    assert d["b0_identity_rounds"] >= 3
+    # the wall-clock split: sync pays ~the whole tail per straggled
+    # round, stale pays ~nothing — judged self-relative to the
+    # artifact's own baseline so the claim is machine-independent
+    tail_ms = d["tail_s"] * 1e3
+    assert d["sync_slow_round_ms_p50"] >= (
+        d["baseline_round_ms_p50"] + 0.8 * tail_ms
+    )
+    assert d["stale_slow_round_ms_p50"] <= 1.25 * d["baseline_round_ms_p50"]
+    assert d["stale_straggler_penalty_pct"] <= 25.0
+    assert d["sync_straggler_penalty_pct"] > d["stale_straggler_penalty_pct"]
+    # the transient window sat strictly under the bound: nothing forced
+    assert d["forced_folds"] == 0
+    assert len(d["slow_rounds"]) < d["stale_bound"]
+    assert d["max_staleness"] <= d["stale_bound"]
+    assert d["staleness_gauge_straggler"] >= 1.0
+    assert d["wallclock_saved_s"] >= 0.6 * d["tail_injected_s"]
+    # one-sided: staleness never WORSE than sync beyond the band
+    assert d["loss_band_ok"] is True
+    assert d["final_loss"] <= d["sync_final_loss"] + d["loss_band"]
+    # the asymmetric two-tier leg ran both tiers and named the slice
+    assert set(d["hier_tiers"]) == {"cross", "intra"}
+    assert d["hier_laggiest_ok"] is True and d["hier_finite"] is True
+    assert len(d["hier_straggler_slice"]) >= 2
+    for phrase in ("MODELED", "non-claim", "one-sided"):
+        assert phrase.lower() in d["note"].lower(), phrase
 
 
 @pytest.mark.slow

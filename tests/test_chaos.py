@@ -87,6 +87,21 @@ def test_default_plan_covers_every_fault_class():
     # like the serve faults) and inside the run
     assert plan.driver_kill_round is not None
     assert plan.preempt_round < plan.driver_kill_round < plan.rounds
+    # the slow_slice fault (round 4): the bounded-staleness straggler
+    # A/B fires AFTER the preemption (a bounded sub-scenario on the
+    # resumed process, like driver_kill — firing before the preempt
+    # would let the replay re-enter and re-fire the whole A/B), on a
+    # round distinct from driver_kill so the two sub-scenarios' wall
+    # clocks stay attributable, targets a real multi-worker slice
+    # DISTINCT from the preempted one, and the transient slow window
+    # sits strictly under the bound so zero forced waits is achievable
+    assert plan.slow_slice_round is not None
+    assert plan.preempt_round < plan.slow_slice_round < plan.rounds
+    assert plan.slow_slice_round != plan.driver_kill_round
+    assert plan.slow_slice_slice != plan.slice_preempt_slice
+    assert len(spec.slices[plan.slow_slice_slice]) >= 2
+    assert plan.slow_slice_rounds < plan.slow_slice_stale_bound
+    assert plan.slow_slice_s < plan.stall_timeout_s
 
 
 def test_no_fault_view_strips_all_faults():
@@ -102,6 +117,7 @@ def test_no_fault_view_strips_all_faults():
     assert base.publish_corrupt_round is None
     assert base.slice_preempt_round is None
     assert base.driver_kill_round is None
+    assert base.slow_slice_round is None
     # run geometry unchanged: the baseline is comparable — including
     # the two-tier hierarchy shape (both legs run the same schedule)
     plan2 = chaos.FaultPlan.default()
@@ -289,6 +305,22 @@ def test_chaos_smoke_default_plan(tmp_path):
     assert dk["journal_truncated_bytes"] > 0
     assert dk["replayed_rounds"] <= 1
     assert dk["resumed_digest"] == dk["control_digest"]
+
+    # the slow_slice fault (round 4): the bounded-staleness straggler
+    # A/B — the sync control pays the whole injected tail, the stale
+    # leg absorbs it with ZERO bound-forced waits, saves most of the
+    # wall-clock, names the laggiest worker inside the slow slice, and
+    # lands in the sync control's loss band
+    assert rep["faults"]["slow_slice"]["survived"] == 1
+    ss = rep["slow_slice"]
+    assert ss["survived"] and ss["straggler_named_ok"]
+    assert ss["stale"]["forced_waits"] == 0
+    assert ss["sync"]["tail_paid_s"] >= ss["tail_injected_s"] - 1e-9
+    assert ss["wallclock_saved_s"] >= 0.6 * ss["tail_injected_s"]
+    assert ss["loss_band_ok"]
+    assert set(ss["stale"]["laggiest_by_slow_round"]) <= set(
+        ss["workers"]
+    )
 
     # quarantined files really are on disk, out of the resume scan
     corrupt = [f for f in os.listdir(str(tmp_path)) if f.endswith(".corrupt")]

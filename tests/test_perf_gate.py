@@ -325,6 +325,10 @@ GOOD_RECOVER = {
     "bit_identical_all": True, "max_replayed_rounds": 1,
     "no_journal_diverged": True, "journal_bit_neutral": True,
     "journal_overhead_pct": 0.4,
+    "stale": {
+        "survived": True, "bit_identical": True,
+        "replayed_rounds": 1, "stale_bound": 2,
+    },
 }
 
 
@@ -366,6 +370,35 @@ def test_recover_family_rules(tmp_path):
     assert any(
         "killpoints_survived" in r["detail"] for r in rows if not r["ok"]
     )
+    # the stale kill-leg (ISSUE 17): a failed survival, a drifted
+    # resume, or a replay past the artifact's OWN stale_bound fails
+    # even with the flat sweep perfect
+    for bad_stale, needle in (
+        (dict(GOOD_RECOVER["stale"], survived=False),
+         "stale.survived"),
+        (dict(GOOD_RECOVER["stale"], bit_identical=False),
+         "stale.bit_identical"),
+        (dict(GOOD_RECOVER["stale"], replayed_rounds=3),
+         "replayed_rounds"),
+        (dict(GOOD_RECOVER["stale"], stale_bound=0),
+         "stale_bound"),
+    ):
+        _write(
+            tmp_path, "RECOVER_r18.json",
+            dict(GOOD_RECOVER, stale=bad_stale),
+        )
+        rc, rows = g.check(str(tmp_path))
+        assert rc == 1, needle
+        assert any(
+            needle in r["detail"] for r in rows if not r["ok"]
+        ), (needle, rows)
+    # a RECOVER artifact missing the stale leg entirely is a failure,
+    # not a silent pass
+    bad = dict(GOOD_RECOVER)
+    del bad["stale"]
+    _write(tmp_path, "RECOVER_r18.json", bad)
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 1
 
 
 def test_missing_key_is_a_failure_not_a_pass(tmp_path):
@@ -597,3 +630,118 @@ def test_genserve_family_rules(tmp_path):
         assert any(
             "divergence_max" in r["detail"] for r in rows if not r["ok"]
         ), (div, rows)
+
+
+GOOD_CHAOS = {
+    "value": 5, "loss_band_ok": True,
+    "faults_injected": 5, "faults_survived": 5,
+    "slow_slice": {
+        "survived": True, "straggler_named_ok": True,
+        "loss_band_ok": True, "stale": {"forced_waits": 0},
+    },
+}
+
+
+def test_chaos_family_rules(tmp_path):
+    """The CHAOS family's slow_slice leg (ISSUE 17): the dotted-path
+    rules reach inside the nested A/B — a forced wait, an unnamed
+    straggler, or a blown loss band in the slow-slice scenario fails
+    --check even with every top-level fault survived."""
+    g = _gate()
+    _write(tmp_path, "CHAOS_r19.json", GOOD_CHAOS)
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 0, rows
+    ss = GOOD_CHAOS["slow_slice"]
+    for bad_ss, needle in (
+        (dict(ss, survived=False), "slow_slice.survived"),
+        (dict(ss, straggler_named_ok=False),
+         "slow_slice.straggler_named_ok"),
+        (dict(ss, loss_band_ok=False), "slow_slice.loss_band_ok"),
+        (dict(ss, stale={"forced_waits": 2}),
+         "slow_slice.stale.forced_waits"),
+    ):
+        _write(
+            tmp_path, "CHAOS_r20.json",
+            dict(GOOD_CHAOS, slow_slice=bad_ss),
+        )
+        rc, rows = g.check(str(tmp_path))
+        assert rc == 1, needle
+        assert any(
+            needle in r["detail"] for r in rows if not r["ok"]
+        ), (needle, rows)
+    # the survival extra rule still applies alongside the nested leg
+    _write(
+        tmp_path, "CHAOS_r20.json", dict(GOOD_CHAOS, faults_survived=4)
+    )
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 1
+    assert any(
+        "faults_survived" in r["detail"] for r in rows if not r["ok"]
+    )
+    # a missing nested leg is a failure, not a silent pass
+    bad = dict(GOOD_CHAOS)
+    del bad["slow_slice"]
+    _write(tmp_path, "CHAOS_r20.json", bad)
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 1
+    assert any("MISSING" in r["detail"] for r in rows if not r["ok"])
+
+
+GOOD_STALE = {
+    "value": 1.3, "b0_bit_identical": True,
+    "b0_flat_bit_identical": True, "b0_hier_bit_identical": True,
+    "stale_straggler_penalty_pct": 1.3, "forced_folds": 0,
+    "stale_bound": 4, "loss_band_ok": True,
+    "hier_laggiest_ok": True, "hier_finite": True,
+    "baseline_round_ms_p50": 2750.0, "tail_s": 2.75,
+    "sync_slow_round_ms_p50": 5790.0,
+    "stale_slow_round_ms_p50": 2780.0,
+}
+
+
+def test_stale_family_rules(tmp_path):
+    """The STALE family (ISSUE 17): B=0 bitwise identical to the sync
+    trainer on both topologies, the straggled-round penalty inside the
+    pinned band, zero bound-forced folds, the one-sided loss band, and
+    the two-tier laggiest attribution — any one regressing fails
+    --check."""
+    g = _gate()
+    _write(tmp_path, "STALE_r20.json", GOOD_STALE)
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 0, rows
+    for bad_field, bad_value in (
+        ("b0_bit_identical", False),        # B=0 drifted off sync
+        ("b0_flat_bit_identical", False),   # the flat pin broke
+        ("b0_hier_bit_identical", False),   # the two-tier pin broke
+        ("stale_straggler_penalty_pct", 30.0),  # tail leaked back in
+        ("forced_folds", 1),                # the bound bit mid-window
+        ("stale_bound", 0),                 # vacuous: B=0 is just sync
+        ("loss_band_ok", False),            # staleness hurt convergence
+        ("hier_laggiest_ok", False),        # wrong slice named laggiest
+        ("hier_finite", False),             # two-tier losses blew up
+    ):
+        _write(
+            tmp_path, "STALE_r21.json",
+            dict(GOOD_STALE, **{bad_field: bad_value}),
+        )
+        rc, rows = g.check(str(tmp_path))
+        assert rc == 1, bad_field
+        assert any(
+            bad_field in r["detail"] for r in rows if not r["ok"]
+        ), (bad_field, rows)
+    # the wall-clock extra rule, self-relative to the artifact's OWN
+    # calibrated tail: a stale leg drifting past 1.25x baseline, or a
+    # sync control that never actually paid the tail (vacuous split),
+    # fails even with the static penalty field inside its band
+    for wc in (
+        {"stale_slow_round_ms_p50": 3600.0},  # stale leg paid the tail
+        {"sync_slow_round_ms_p50": 3000.0},   # control never paid it
+        {"tail_s": 0.0},                      # no tail injected at all
+    ):
+        _write(tmp_path, "STALE_r21.json", dict(GOOD_STALE, **wc))
+        rc, rows = g.check(str(tmp_path))
+        assert rc == 1, wc
+        assert any(
+            "stale_slow_round_ms_p50" in r["detail"]
+            for r in rows if not r["ok"]
+        ), (wc, rows)

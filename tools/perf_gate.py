@@ -101,6 +101,14 @@ RULES: Dict[str, List[Rule]] = {
     "CHAOS": [
         Rule("loss_band_ok", "is", True),
         Rule("faults_injected", ">", 0),
+        # the slow_slice A/B (runtime/chaos._slow_slice_scenario): the
+        # stale leg absorbed the whole slice's tail with zero forced
+        # waits while the sync control paid it, and the staleness
+        # ledger named a slow-slice member laggiest every slow round
+        Rule("slow_slice.survived", "is", True),
+        Rule("slow_slice.straggler_named_ok", "is", True),
+        Rule("slow_slice.stale.forced_waits", "==", 0),
+        Rule("slow_slice.loss_band_ok", "is", True),
     ],
     "PIPELINE": [
         Rule("value", ">", 1.0),  # pipelined strictly faster than serial
@@ -225,6 +233,11 @@ RULES: Dict[str, List[Rule]] = {
         Rule("no_journal_diverged", "is", True),
         Rule("journal_bit_neutral", "is", True),
         Rule("journal_overhead_pct", "<", 3.0),
+        # the bounded-staleness leg: SIGKILL at the stale_boundary
+        # phase, resumed from the journaled worker-round vector
+        # bit-identically (the <=stale_bound replay is the extra rule)
+        Rule("stale.survived", "is", True),
+        Rule("stale.bit_identical", "is", True),
     ],
     "LM": [
         # the transformer-LM workload contract (bench.py --mode=lm):
@@ -273,6 +286,27 @@ RULES: Dict[str, List[Rule]] = {
         Rule("rollback_exact", "is", True),
         Rule("rollback_dropped_streams", "==", 0),
         Rule("incumbent_held_after_rollback", "is", True),
+    ],
+    "STALE": [
+        # the bounded-staleness contract (bench.py --mode=stale):
+        # --stale_bound 0 BITWISE identical to the sync trainer (flat
+        # and two-tier), the transient straggler's tail off the
+        # critical path (straggled-round p50 within the pinned band of
+        # the no-straggler baseline — the extra rule makes the split
+        # artifact-self-relative), zero bound-forced folds inside the
+        # window (K < B by construction), the final loss inside the
+        # sync control's band, and the asymmetric two-tier leg naming
+        # the straggler's coarsened slice laggiest with finite losses
+        Rule("value", "<=", 25.0),
+        Rule("b0_bit_identical", "is", True),
+        Rule("b0_flat_bit_identical", "is", True),
+        Rule("b0_hier_bit_identical", "is", True),
+        Rule("stale_straggler_penalty_pct", "<=", 25.0),
+        Rule("forced_folds", "==", 0),
+        Rule("stale_bound", ">=", 1),
+        Rule("loss_band_ok", "is", True),
+        Rule("hier_laggiest_ok", "is", True),
+        Rule("hier_finite", "is", True),
     ],
     "DATACACHE": [
         # the I/O-flat contract: a warm (cache-filled, shuffled-
@@ -378,6 +412,42 @@ def _recover_survival_rule(art: dict) -> Tuple[bool, str]:
     )
 
 
+def _recover_stale_replay_rule(art: dict) -> Tuple[bool, str]:
+    """The stale leg's replay must sit inside the artifact's OWN bound:
+    a stale_boundary kill rewinds to the journaled worker-round vector
+    and re-executes at most stale_bound rounds."""
+    s = art.get("stale") or {}
+    rep, bound = s.get("replayed_rounds"), s.get("stale_bound")
+    ok = bool(
+        bound and rep is not None and 0 <= rep <= bound
+    )
+    return ok, (
+        "stale.replayed_rounds=%r <= stale.stale_bound=%r" % (rep, bound)
+    )
+
+
+def _stale_wallclock_rule(art: dict) -> Tuple[bool, str]:
+    """The penalty split, self-relative to the artifact's own tail:
+    the stale leg's straggled-round p50 sits within the pinned band of
+    the no-straggler baseline while the sync control measurably pays
+    the tail it injected — whatever tail_s the bench calibrated."""
+    base = art.get("baseline_round_ms_p50") or 0
+    sync = art.get("sync_slow_round_ms_p50") or 0
+    stale = art.get("stale_slow_round_ms_p50")
+    tail_ms = 1e3 * (art.get("tail_s") or 0)
+    ok = bool(
+        base and tail_ms and stale is not None
+        and stale <= base * 1.25
+        and sync >= base + 0.8 * tail_ms
+    )
+    return ok, (
+        "stale_slow_round_ms_p50=%r <= 1.25*baseline=%r and "
+        "sync_slow_round_ms_p50=%r >= baseline+0.8*tail=%r"
+        % (stale, round(base * 1.25, 1), sync,
+           round(base + 0.8 * tail_ms, 1))
+    )
+
+
 def _genserve_kv_rule(art: dict) -> Tuple[bool, str]:
     a, f = art.get("kv_allocated_total"), art.get("kv_freed_total")
     ok = bool(a is not None and a > 0 and a == f)
@@ -407,7 +477,8 @@ _EXTRA_RULES = {
     "CHAOS": [_chaos_survival_rule],
     "PIPELINE": [_pipeline_order_rule],
     "ELASTIC": [_elastic_ratio_rule],
-    "RECOVER": [_recover_survival_rule],
+    "RECOVER": [_recover_survival_rule, _recover_stale_replay_rule],
+    "STALE": [_stale_wallclock_rule],
     "LM": [_lm_tolerance_rule],
     "GENSERVE": [_genserve_kv_rule, _genserve_divergence_rule],
 }
