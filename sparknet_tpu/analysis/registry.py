@@ -41,6 +41,11 @@ CANONICAL_METRICS = {
     "sparknet_collective_bytes_total": ("compress",),
     "sparknet_quant_error_max_abs": ("compress",),
     "sparknet_quant_snr_db": ("compress",),
+    # Pallas custom-kernel routing (ops/pallas_attention.lowerable()
+    # gate): which hot paths ride fused kernels, and how many fused
+    # epilogue kernel launches the comm plane issued
+    "sparknet_kernel_path": ("kernel",),
+    "sparknet_kernel_fused_chunks_total": ("stage",),
     "sparknet_hidden_fraction": ("kind",),
     "sparknet_worker_skew": (),
     "sparknet_straggler_worker": (),

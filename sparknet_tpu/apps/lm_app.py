@@ -48,6 +48,13 @@ def add_lm_model_args(parser) -> None:
     parser.add_argument("--base_lr", type=float, default=0.1)
     parser.add_argument("--momentum", type=float, default=0.9)
     parser.add_argument("--weight_decay", type=float, default=1e-4)
+    parser.add_argument(
+        "--dense_attention", action="store_true",
+        help="train with the dense XLA attention reference instead of "
+        "the Pallas flash kernel (the kernel is the default wherever it "
+        "lowers natively — ops/pallas_attention.lowerable(); this flag "
+        "is the explicit fallback, and the A/B lever for KERNELS_r21)",
+    )
 
 
 def build_lm_solver(args, sp: int):
@@ -64,6 +71,12 @@ def build_lm_solver(args, sp: int):
         seq_len=args.seq_len,
         sp_axis="sp" if sp > 1 else None,
         sp_size=sp,
+        # --dense_attention is the explicit fallback; the default
+        # ("auto") rides the Pallas flash kernel wherever it lowers
+        # natively (getattr: bench Namespaces predate the flag)
+        attention=(
+            "dense" if getattr(args, "dense_attention", False) else "auto"
+        ),
     )
     solver_param = parse_solver_prototxt(
         f"base_lr: {args.base_lr} "
@@ -77,6 +90,15 @@ def build_lm_solver(args, sp: int):
         net=lm,
         grad_reduce_axes=("sp",) if sp > 1 else (),
     )
+    from sparknet_tpu import obs
+    from sparknet_tpu.ops import pallas_attention
+
+    tm = obs.training_metrics()
+    if tm is not None:
+        on_kernel = lm.attention == "flash" or (
+            lm.attention == "auto" and pallas_attention.lowerable()
+        )
+        tm.kernel_path.labels("attention").set(1.0 if on_kernel else 0.0)
     return lm, solver
 
 

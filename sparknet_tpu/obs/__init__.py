@@ -223,6 +223,20 @@ class TrainingMetrics:
             "error underflows to 0), by compression mode",
             labels=("compress",),
         )
+        self.kernel_path = registry.gauge(
+            "sparknet_kernel_path",
+            "1 when the named hot path rides its fused Pallas kernel, "
+            "0 on the dense/XLA fallback (the ops/pallas_attention."
+            "lowerable() routing gate; kernel=attention|epilogue)",
+            labels=("kernel",),
+        )
+        self.kernel_fused_chunks = registry.counter(
+            "sparknet_kernel_fused_chunks_total",
+            "fused averaging-epilogue kernel launches by the comm "
+            "plane (one per comm chunk per stage per round; "
+            "stage=encode|apply — ops/pallas_comm.py)",
+            labels=("stage",),
+        )
         # round-anatomy profiler series (obs/profile.py, --profile) —
         # zero until a RoundProfiler is installed
         self.hidden_fraction = registry.gauge(

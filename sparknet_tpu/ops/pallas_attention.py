@@ -1,15 +1,33 @@
-"""Fused attention kernel in Pallas — the hot-op custom kernel path.
+"""Fused attention kernels in Pallas — the hot-op custom kernel path.
 
-Per-(batch*head, q-block) grid cell: one MXU matmul Q.K^T, masked softmax
-on the VPU, one MXU matmul P.V — all in VMEM, no HBM round-trip for the
-scores matrix (the thing that makes naive attention bandwidth-bound).
-K/V live whole in VMEM per cell, which is fine for the single-chip
-sequence lengths this framework targets; beyond that the ring path
-(``parallel.ring_attention``) shards the sequence first and each shard's
-local attention goes through this kernel.
+Forward, per-(batch*head, q-block) grid cell: one MXU matmul Q.K^T,
+masked softmax on the VPU, one MXU matmul P.V — all in VMEM, no HBM
+round-trip for the scores matrix (the thing that makes naive attention
+bandwidth-bound).  K/V live whole in VMEM per cell, which is fine for
+the single-chip sequence lengths this framework targets; beyond that
+the ring path (``parallel.ring_attention``) shards the sequence first
+and each shard's local attention goes through this kernel.
 
-On non-TPU backends the kernel runs in interpreter mode so tests pin it
-against ``mha_reference`` everywhere.
+Backward (``jax.custom_vjp``): the FlashAttention recipe — RECOMPUTE
+the scores from the saved ``(q, k, v, o, lse)`` residuals instead of
+ever writing the (T_q, T_k) probability matrix to HBM.  Two kernels:
+a dq pass gridded like the forward (per q-block, scores live only in
+VMEM) and a dk/dv pass per (batch*head) cell.  Both use the identity
+``ds = p * (dp - (rowsum(do*o) - dlse))`` where ``p = exp(s - lse)``
+is rebuilt in-cell; the ``dlse`` term makes the (o, lse) pair an
+honest differentiable output, which is what lets the ring path merge
+per-step partial attentions and still get exact gradients.
+
+Position bookkeeping is absolute: kernels take a (q_offset, k_offset)
+pair so the same code serves the end-aligned dense convention
+(``mha_reference``'s ``tril(k=tk-tq)`` — offset ``(tk - tq, 0)``) and
+the ring's per-shard global positions.  A T_q that does not divide
+``block_q`` is end-padded (padded rows attend unmasked, stay finite,
+and are sliced off; their cotangents are zero) — only T_q=0 errors.
+
+On non-TPU backends the kernels run in interpreter mode so tests pin
+forward AND backward against ``mha_reference`` / ``jax.grad`` of it
+everywhere.
 """
 
 from __future__ import annotations
@@ -22,68 +40,278 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, tq, tk):
-    j = pl.program_id(1)
-    q = q_ref[0]  # (block_q, d)
-    k = k_ref[0]  # (tk, d)
-    v = v_ref[0]
-    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-    if causal:
-        # end-aligned causal convention (mha_reference's tril(k=tk-tq))
-        q_pos = (tk - tq) + j * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (q.shape[0], tk), 0
-        )
-        k_pos = jax.lax.broadcasted_iota(jnp.int32, (q.shape[0], tk), 1)
-        s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
-    m = jnp.max(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - m)
-    o = jnp.dot(p, v, preferred_element_type=jnp.float32)
-    o_ref[0] = (o / jnp.sum(p, axis=-1, keepdims=True)).astype(o_ref.dtype)
-
-
 def lowerable() -> bool:
     """True when the Pallas kernels lower natively on this backend.
-    The serving decode path (``serve/generate.py``) gates on this: TPU
-    takes the kernel, everything else takes the dense reference —
+    The single source of truth for "custom kernels run here": serving
+    decode, the LM train-step attention, the comm plane's fused
+    epilogue and the LRN/pool kernels all gate on this — TPU takes the
+    kernel, everything else takes the dense/XLA reference, and
     interpreter mode stays a test-only tool (it is far slower than the
     XLA-compiled reference on CPU)."""
     return jax.default_backend() in ("tpu",)
 
 
-def flash_attention(
-    q, k, v, causal: bool = False, block_q: int = 128, interpret=None
-):
-    """Fused attention on (B, T, H, D); bit-comparable to
-    ``mha_reference`` (same softmax, fp32 accumulation)."""
-    if interpret is None:
-        interpret = jax.default_backend() not in ("tpu",)
-    b, tq, h, d = q.shape
-    tk = k.shape[1]
-    block_q = min(block_q, tq)
-    if tq % block_q:
-        raise ValueError(f"T_q {tq} not divisible by block_q {block_q}")
-    scale = 1.0 / math.sqrt(d)
+def _causal_mask(offs_ref, rows, tk, row0):
+    """(rows, tk) bool mask from ABSOLUTE positions: query row r of
+    this block sits at ``q_offset + row0 + r``, key column c at
+    ``k_offset + c``.  Offsets ride in as a (1, 2) f32 block (traced
+    scalars — the ring's ``axis_index`` arithmetic — can't be static
+    kernel params)."""
+    q0 = offs_ref[0, 0].astype(jnp.int32)
+    k0 = offs_ref[0, 1].astype(jnp.int32)
+    q_pos = q0 + row0 + jax.lax.broadcasted_iota(jnp.int32, (rows, tk), 0)
+    k_pos = k0 + jax.lax.broadcasted_iota(jnp.int32, (rows, tk), 1)
+    return k_pos <= q_pos
 
-    def flat(x):
-        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, x.shape[1], d)
 
-    qf, kf, vf = flat(q), flat(k), flat(v)
-    kernel = partial(
-        _attn_kernel, scale=scale, causal=causal, block_q=block_q, tq=tq, tk=tk
+def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                scale, causal, block_q):
+    j = pl.program_id(1)
+    q = q_ref[0]  # (block_q, d)
+    k = k_ref[0]  # (tk, d)
+    v = v_ref[0]
+    tk = k.shape[0]
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = _causal_mask(offs_ref, q.shape[0], tk, j * block_q)
+        s = jnp.where(mask, s, -jnp.inf)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    # fully-masked rows (ring steps ahead of the causal frontier) must
+    # come out (o=0, lse=-inf), not NaN — guard the exp and the divide
+    m_safe = jnp.where(m == -jnp.inf, 0.0, m)
+    p = jnp.exp(s - m_safe)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.dot(p, v, preferred_element_type=jnp.float32)
+    o_ref[0] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    lse_ref[0] = jnp.where(
+        l[:, 0] > 0, m_safe[:, 0] + jnp.log(l[:, 0]), -jnp.inf
     )
-    out = pl.pallas_call(
+
+
+def _bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, o_ref,
+                   lse_ref, dlse_ref, dq_ref, *, scale, causal, block_q):
+    j = pl.program_id(1)
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0].astype(jnp.float32)
+    o = o_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    tk = k.shape[0]
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = _causal_mask(offs_ref, q.shape[0], tk, j * block_q)
+        s = jnp.where(mask, s, -jnp.inf)
+    # recompute normalized probabilities from the lse residual; a
+    # fully-masked row has lse=-inf and s=-inf — substitute lse=0 so
+    # exp(-inf - 0) = 0 instead of exp(nan)
+    lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
+    p = jnp.exp(s - lse_safe[:, None])
+    delta = jnp.sum(do * o, axis=-1) - dlse_ref[0]
+    dp = jnp.dot(do, v.astype(jnp.float32).T,
+                 preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, None]) * scale
+    dq_ref[0] = jnp.dot(
+        ds, k.astype(jnp.float32), preferred_element_type=jnp.float32
+    ).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, o_ref,
+                    lse_ref, dlse_ref, dk_ref, dv_ref, *, scale, causal):
+    q = q_ref[0]  # (tq, d) — whole padded T_q per (batch*head) cell
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0].astype(jnp.float32)
+    o = o_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    tk = k.shape[0]
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = _causal_mask(offs_ref, q.shape[0], tk, 0)
+        s = jnp.where(mask, s, -jnp.inf)
+    lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
+    p = jnp.exp(s - lse_safe[:, None])
+    dv_ref[0] = jnp.dot(
+        p.T, do, preferred_element_type=jnp.float32
+    ).astype(dv_ref.dtype)
+    delta = jnp.sum(do * o, axis=-1) - dlse_ref[0]
+    dp = jnp.dot(do, v.astype(jnp.float32).T,
+                 preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, None]) * scale
+    dk_ref[0] = jnp.dot(
+        ds.T, q.astype(jnp.float32), preferred_element_type=jnp.float32
+    ).astype(dk_ref.dtype)
+
+
+def _fwd_call(qf, kf, vf, offs, causal, block_q, interpret):
+    n, tq, d = qf.shape
+    tk = kf.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    kernel = partial(_fwd_kernel, scale=scale, causal=causal,
+                     block_q=block_q)
+    return pl.pallas_call(
         kernel,
-        grid=(b * h, tq // block_q),
+        grid=(n, tq // block_q),
         in_specs=[
+            pl.BlockSpec((1, 2), lambda i, j: (0, 0)),
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, tq, d), qf.dtype),
+            jax.ShapeDtypeStruct((n, tq), jnp.float32),
+        ],
         interpret=interpret,
-    )(qf, kf, vf)
-    return jnp.transpose(out.reshape(b, h, tq, d), (0, 2, 1, 3))
+    )(offs, qf, kf, vf)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_core(qf, kf, vf, offs, causal, block_q, interpret):
+    """(o, lse) over flattened (B*H, T, D) inputs; T_q already padded
+    to a ``block_q`` multiple.  ``offs`` is the f32 (1, 2) absolute
+    (q_offset, k_offset) pair; differentiable in q/k/v AND honest in
+    the lse output (nonzero dlse cotangents — the ring merge — feed
+    the backward's delta term)."""
+    return _fwd_call(qf, kf, vf, offs, causal, block_q, interpret)
+
+
+def _flash_core_fwd(qf, kf, vf, offs, causal, block_q, interpret):
+    o, lse = _fwd_call(qf, kf, vf, offs, causal, block_q, interpret)
+    return (o, lse), (qf, kf, vf, offs, o, lse)
+
+
+def _flash_core_bwd(causal, block_q, interpret, res, cts):
+    qf, kf, vf, offs, o, lse = res
+    do, dlse = cts
+    n, tq, d = qf.shape
+    tk = kf.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    dlse = dlse.astype(jnp.float32)
+    dq_kernel = partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                        block_q=block_q)
+    whole_q = pl.BlockSpec((1, tq, d), lambda i: (i, 0, 0))
+    whole_k = pl.BlockSpec((1, tk, d), lambda i: (i, 0, 0))
+    row_q = pl.BlockSpec((1, tq), lambda i: (i, 0))
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(n, tq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 2), lambda i, j: (0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, tq, d), qf.dtype),
+        interpret=interpret,
+    )(offs, qf, kf, vf, do, o, lse, dlse)
+    dkv_kernel = partial(_bwd_dkv_kernel, scale=scale, causal=causal)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, 2), lambda i: (0, 0)),
+            whole_q, whole_k, whole_k, whole_q, whole_q, row_q, row_q,
+        ],
+        out_specs=[whole_k, whole_k],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, tk, d), kf.dtype),
+            jax.ShapeDtypeStruct((n, tk, d), vf.dtype),
+        ],
+        interpret=interpret,
+    )(offs, qf, kf, vf, do, o, lse, dlse)
+    return dq, dk, dv, jnp.zeros_like(offs)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def _flatten_heads(x):
+    b, t, h, d = x.shape
+    return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
+
+
+def _pad_to_block(qf, block_q):
+    """End-pad the flattened query rows to a block_q multiple; real
+    rows keep their original absolute positions (the offset is derived
+    from the UNPADDED T_q), padded rows attend unmasked (finite, no
+    NaN) and are sliced off by the caller."""
+    tq = qf.shape[1]
+    pad = (-tq) % block_q
+    if pad:
+        qf = jnp.pad(qf, ((0, 0), (0, pad), (0, 0)))
+    return qf, pad
+
+
+def flash_attention(
+    q, k, v, causal: bool = False, block_q: int = 128, interpret=None
+):
+    """Fused attention on (B, T, H, D) with a fused flash backward;
+    bit-comparable to ``mha_reference`` (same softmax, same end-aligned
+    ``tril(k=tk-tq)`` causal convention, fp32 accumulation) and
+    grad-pinned against ``jax.grad`` of it.  Any T_q >= 1 works — a
+    ragged T_q is end-padded to the q-block internally."""
+    if interpret is None:
+        interpret = not lowerable()
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    if tq == 0:
+        raise ValueError(
+            "flash_attention: T_q=0 — an empty query block has no "
+            "attention output (check the caller's slicing)"
+        )
+    block_q = min(block_q, tq)
+    qf, pad = _pad_to_block(_flatten_heads(q), block_q)
+    kf, vf = _flatten_heads(k), _flatten_heads(v)
+    offs = jnp.asarray([[tk - tq, 0]], jnp.float32)
+    o, _ = _flash_core(qf, kf, vf, offs, causal, block_q, bool(interpret))
+    if pad:
+        o = o[:, :tq]
+    return jnp.transpose(o.reshape(b, h, tq, d), (0, 2, 1, 3))
+
+
+def flash_attention_step(
+    q, k, v, q_offset, k_offset, causal: bool = False,
+    block_q: int = 128, interpret=None
+):
+    """One partial-attention step over a KV shard, for the ring path.
+
+    ``q``/``k``/``v`` are (B, T_q, H, D)/(B, T_k, H, D) local shards;
+    ``q_offset``/``k_offset`` are ABSOLUTE global positions of their
+    first rows (traced scalars — ring-index arithmetic).  Returns
+    ``(o (B, H, T_q, D), lse (B, H, T_q))`` — normalized within the
+    shard, with the row logsumexp so the caller can merge steps via
+    the online-softmax combine; a fully-masked row is (0, -inf).
+    Gradients are exact through BOTH outputs (the dlse term)."""
+    if interpret is None:
+        interpret = not lowerable()
+    b, tq, h, d = q.shape
+    block_q = min(block_q, tq)
+    qf, pad = _pad_to_block(_flatten_heads(q), block_q)
+    kf, vf = _flatten_heads(k), _flatten_heads(v)
+    if causal:
+        offs = jnp.stack(
+            [jnp.asarray(q_offset, jnp.float32),
+             jnp.asarray(k_offset, jnp.float32)]
+        ).reshape(1, 2)
+    else:
+        # non-causal kernels never read the offsets; keeping the traced
+        # axis-index arithmetic out of the (DCE'd) operand sidesteps an
+        # XLA SPMD PartitionId lowering bug under shard_map
+        offs = jnp.zeros((1, 2), jnp.float32)
+    o, lse = _flash_core(qf, kf, vf, offs, causal, block_q, bool(interpret))
+    if pad:
+        o, lse = o[:, :tq], lse[:, :tq]
+    return o.reshape(b, h, tq, d), lse.reshape(b, h, tq)
 
 
 # ----------------------------------------------------------------------

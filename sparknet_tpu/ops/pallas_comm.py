@@ -1,0 +1,281 @@
+"""Fused averaging-epilogue kernels in Pallas — the comm plane's
+per-round hot path as single-pass programs.
+
+``parallel/comm.py`` runs three epilogue programs per averaging round:
+delta-encode (momentum-advanced params minus anchor, plus the
+error-feedback residual, quantized per tensor with the new residual
+written back), and one of two applies (barriered consensus overwrite,
+or the overlap correction ``mean - dequant(own)`` onto params AND
+anchor).  Unfused, each is a chain of separate XLA ops that round-trips
+the full-model delta / correction through HBM between every step.  The
+kernels here do each program as ONE ``pallas_call`` per comm chunk:
+grid over the worker dim, every leaf of the chunk rides in as its own
+ref (no packing copies), and a static Python loop inside the cell walks
+the leaves — read x/a/r once, write q/scale/residual once.
+
+Numerical contract (pinned by ``tests/test_pallas_comm.py`` and
+``bench.py --mode=kernels``): the fused kernels are BIT-IDENTICAL to
+the unfused closures in interpret mode — same op order per element
+(delta = (x - a) + r; amax/127 int8 grid with rint+clip; bf16 cast;
+err = delta - dequant), so the compress=none/fp32 legs match the
+unfused trainer exactly and the compressed legs inherit COMM_r11's
+pinned loss bands unchanged.
+
+Routing mirrors every other kernel in ``ops/``: native where
+``pallas_attention.lowerable()`` holds, interpreter mode as the
+explicit test/bench tool, unfused XLA closures elsewhere (the
+``CommPlane(fused=...)`` knob).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from sparknet_tpu.ops.pallas_attention import lowerable
+
+
+def _resolve_interpret(interpret):
+    if interpret is None:
+        return not lowerable()
+    return bool(interpret)
+
+
+def _leaf_block(leaf):
+    """Per-worker block spec of a worker-stacked (W, ...) leaf: one
+    worker's slice per grid cell."""
+    shape = (1,) + tuple(leaf.shape[1:])
+    nd = leaf.ndim
+
+    def index(i, _nd=nd):
+        return (i,) + (0,) * (_nd - 1)
+
+    return pl.BlockSpec(shape, index)
+
+
+def _whole_block(arr):
+    """Every cell reads the same unstacked array (a chunk mean)."""
+    nd = arr.ndim
+
+    def index(i, _nd=nd):
+        return (0,) * _nd
+
+    return pl.BlockSpec(tuple(arr.shape), index)
+
+
+def _quantize(delta, mode):
+    """One leaf's per-tensor quantize — the EXACT op order of the
+    unfused ``encode_fn`` (bitwise identity is the contract)."""
+    if mode == "bf16":
+        q = delta.astype(jnp.bfloat16)
+        return q, jnp.float32(0.0), q.astype(jnp.float32)
+    if mode == "int8":
+        amax = jnp.max(jnp.abs(delta))
+        scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+        q = jnp.clip(jnp.rint(delta / scale), -127, 127).astype(jnp.int8)
+        return q, scale, q.astype(jnp.float32) * scale
+    return delta, jnp.float32(0.0), delta  # fp32 / none
+
+
+def _encode_kernel(*refs, modes, with_err):
+    n = len(modes)
+    xs, anchors, resids = refs[0:n], refs[n:2 * n], refs[2 * n:3 * n]
+    qs, scales, new_resids = (
+        refs[3 * n:4 * n], refs[4 * n:5 * n], refs[5 * n:6 * n]
+    )
+    err_ref = refs[6 * n] if with_err else None
+    max_abs = jnp.float32(0.0)
+    delta_sq = jnp.float32(0.0)
+    err_sq = jnp.float32(0.0)
+    for x_ref, a_ref, r_ref, q_ref, s_ref, nr_ref, mode in zip(
+        xs, anchors, resids, qs, scales, new_resids, modes
+    ):
+        delta = (x_ref[0] - a_ref[0]) + r_ref[0]
+        q, scale, dq = _quantize(delta, mode)
+        err = delta - dq
+        q_ref[0] = q
+        s_ref[0, 0] = scale
+        nr_ref[0] = err
+        if with_err:
+            max_abs = jnp.maximum(max_abs, jnp.max(jnp.abs(err)))
+            err_sq = err_sq + jnp.sum(jnp.square(err))
+            delta_sq = delta_sq + jnp.sum(jnp.square(delta))
+    if with_err:
+        err_ref[0, 0] = max_abs
+        err_ref[0, 1] = delta_sq
+        err_ref[0, 2] = err_sq
+
+
+@partial(jax.jit, static_argnums=(3, 4, 5))
+def fused_encode(leaves, anchors, resids, modes, with_err, interpret):
+    """One-pass momentum-delta encode of a comm chunk.
+
+    ``leaves``/``anchors``/``resids``: tuples of worker-stacked (W, ...)
+    arrays; ``modes``: matching static tuple from ``COMPRESS_MODES``.
+    Returns ``(qs, scales, new_resids, err)`` with per-leaf ``scales``
+    shaped (W,) (f32; 0 outside int8, matching the unfused closure) and
+    ``err`` the (W, 3) per-worker [max_abs, delta_sq, err_sq] readout
+    partials (None unless ``with_err``) — delta, quantize, and the
+    error-feedback residual all written in the SAME kernel pass."""
+    w = leaves[0].shape[0]
+    modes = tuple(modes)
+    kernel = partial(_encode_kernel, modes=modes, with_err=with_err)
+    in_specs = (
+        [_leaf_block(x) for x in leaves]
+        + [_leaf_block(a) for a in anchors]
+        + [_leaf_block(r) for r in resids]
+    )
+    qdt = {"bf16": jnp.bfloat16, "int8": jnp.int8}
+    out_specs = (
+        [_leaf_block(x) for x in leaves]
+        + [pl.BlockSpec((1, 1), lambda i: (i, 0)) for _ in leaves]
+        + [_leaf_block(r) for r in resids]
+    )
+    out_shape = (
+        [
+            jax.ShapeDtypeStruct(x.shape, qdt.get(m, x.dtype))
+            for x, m in zip(leaves, modes)
+        ]
+        + [jax.ShapeDtypeStruct((w, 1), jnp.float32) for _ in leaves]
+        + [jax.ShapeDtypeStruct(r.shape, r.dtype) for r in resids]
+    )
+    if with_err:
+        out_specs.append(pl.BlockSpec((1, 3), lambda i: (i, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((w, 3), jnp.float32))
+    outs = pl.pallas_call(
+        kernel,
+        grid=(w,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=_resolve_interpret(interpret),
+    )(*leaves, *anchors, *resids)
+    n = len(leaves)
+    qs = tuple(outs[0:n])
+    scales = tuple(s.reshape(-1) for s in outs[n:2 * n])
+    new_resids = tuple(outs[2 * n:3 * n])
+    err = outs[3 * n] if with_err else None
+    return qs, scales, new_resids, err
+
+
+def _apply_barriered_kernel(*refs, nleaves):
+    n = nleaves
+    alive_ref, denom0_ref = refs[0], refs[1]
+    xs = refs[2:2 + n]
+    anchors = refs[2 + n:2 + 2 * n]
+    means = refs[2 + 2 * n:2 + 3 * n]
+    resids = refs[2 + 3 * n:2 + 4 * n]
+    new_xs = refs[2 + 4 * n:2 + 5 * n]
+    new_rs = refs[2 + 5 * n:2 + 6 * n]
+    have = denom0_ref[0, 0] > 0
+    rejoin = jnp.logical_and(alive_ref[0, 0] <= 0, have)
+    for x_ref, a_ref, m_ref, r_ref, nx_ref, nr_ref in zip(
+        xs, anchors, means, resids, new_xs, new_rs
+    ):
+        x = x_ref[0]
+        m = m_ref[...]
+        r = r_ref[0]
+        nx_ref[0] = jnp.where(have, a_ref[0] + m, x)
+        nr_ref[0] = jnp.where(rejoin, jnp.zeros_like(r), r)
+
+
+@partial(jax.jit, static_argnums=(6,))
+def fused_apply_barriered(leaves, anchors, means, resids, alive, denom0,
+                          interpret):
+    """One-pass barriered consensus apply of a comm chunk: every
+    worker lands on ``anchor + mean`` (when any worker survived), a
+    masked worker's error-feedback residual resets on rejoin — the
+    unfused ``apply_barriered_fn`` semantics, bit-identical, one
+    kernel.  ``means`` are the unstacked chunk means; ``alive`` (W,),
+    ``denom0`` scalar."""
+    w = leaves[0].shape[0]
+    kernel = partial(_apply_barriered_kernel, nleaves=len(leaves))
+    alive2 = alive.astype(jnp.float32).reshape(w, 1)
+    denom2 = jnp.asarray(denom0, jnp.float32).reshape(1, 1)
+    in_specs = (
+        [pl.BlockSpec((1, 1), lambda i: (i, 0)),
+         pl.BlockSpec((1, 1), lambda i: (0, 0))]
+        + [_leaf_block(x) for x in leaves]
+        + [_leaf_block(a) for a in anchors]
+        + [_whole_block(m) for m in means]
+        + [_leaf_block(r) for r in resids]
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid=(w,),
+        in_specs=in_specs,
+        out_specs=(
+            [_leaf_block(x) for x in leaves]
+            + [_leaf_block(r) for r in resids]
+        ),
+        out_shape=(
+            [jax.ShapeDtypeStruct(x.shape, x.dtype) for x in leaves]
+            + [jax.ShapeDtypeStruct(r.shape, r.dtype) for r in resids]
+        ),
+        interpret=_resolve_interpret(interpret),
+    )(alive2, denom2, *leaves, *anchors, *means, *resids)
+    n = len(leaves)
+    return tuple(outs[0:n]), tuple(outs[n:2 * n])
+
+
+def _apply_correction_kernel(*refs, modes):
+    n = len(modes)
+    xs = refs[0:n]
+    anchors = refs[n:2 * n]
+    qs = refs[2 * n:3 * n]
+    scales = refs[3 * n:4 * n]
+    means = refs[4 * n:5 * n]
+    new_xs = refs[5 * n:6 * n]
+    new_as = refs[6 * n:7 * n]
+    for x_ref, a_ref, q_ref, s_ref, m_ref, nx_ref, na_ref, mode in zip(
+        xs, anchors, qs, scales, means, new_xs, new_as, modes
+    ):
+        q = q_ref[0]
+        if mode == "int8":
+            dq = q.astype(jnp.float32) * s_ref[0, 0]
+        elif mode == "bf16":
+            dq = q.astype(jnp.float32)
+        else:
+            dq = q
+        corr = m_ref[...] - dq
+        nx_ref[0] = x_ref[0] + corr
+        na_ref[0] = a_ref[0] + corr
+
+
+@partial(jax.jit, static_argnums=(5, 6))
+def fused_apply_correction(leaves, anchors, qs, scales, means, modes,
+                           interpret):
+    """One-pass overlap correction of a comm chunk: dequantize the
+    worker's own contribution, subtract from the chunk mean, add the
+    correction to params AND anchor — the unfused
+    ``apply_correction_fn`` semantics, bit-identical, one kernel."""
+    w = leaves[0].shape[0]
+    modes = tuple(modes)
+    kernel = partial(_apply_correction_kernel, modes=modes)
+    scales2 = tuple(s.reshape(w, 1) for s in scales)
+    in_specs = (
+        [_leaf_block(x) for x in leaves]
+        + [_leaf_block(a) for a in anchors]
+        + [_leaf_block(q) for q in qs]
+        + [pl.BlockSpec((1, 1), lambda i: (i, 0)) for _ in scales2]
+        + [_whole_block(m) for m in means]
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid=(w,),
+        in_specs=in_specs,
+        out_specs=(
+            [_leaf_block(x) for x in leaves]
+            + [_leaf_block(a) for a in anchors]
+        ),
+        out_shape=(
+            [jax.ShapeDtypeStruct(x.shape, x.dtype) for x in leaves]
+            + [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in anchors]
+        ),
+        interpret=_resolve_interpret(interpret),
+    )(*leaves, *anchors, *qs, *scales2, *means)
+    n = len(leaves)
+    return tuple(outs[0:n]), tuple(outs[n:2 * n])

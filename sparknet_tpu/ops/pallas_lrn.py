@@ -100,7 +100,11 @@ def _call(kernel, nchw_shape, dtype, args, n, alpha, beta, k, interpret):
 
 def _use_interpret(interpret):
     if interpret is None:
-        return jax.default_backend() not in ("tpu",)
+        # one source of truth for "kernels lower here" — the shared
+        # pallas_attention.lowerable() gate, not a local backend check
+        from sparknet_tpu.ops.pallas_attention import lowerable
+
+        return not lowerable()
     return interpret
 
 

@@ -252,7 +252,11 @@ def _sel_matrices(w: int, pw: int):
 
 def _use_interpret(interpret):
     if interpret is None:
-        return jax.default_backend() not in ("tpu",)
+        # one source of truth for "kernels lower here" — the shared
+        # pallas_attention.lowerable() gate, not a local backend check
+        from sparknet_tpu.ops.pallas_attention import lowerable
+
+        return not lowerable()
     return interpret
 
 

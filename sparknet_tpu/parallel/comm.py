@@ -173,6 +173,7 @@ class CommPlane:
         average_stats: bool = True,
         mask_nonfinite: bool = True,
         batch_spec=None,
+        fused: Optional[bool] = None,
     ):
         if compress not in COMPRESS_MODES:
             raise ValueError(
@@ -204,6 +205,16 @@ class CommPlane:
         self.average_stats = bool(average_stats)
         self.audit = bool(getattr(solver, "audit", False))
         self.mask_nonfinite = bool(mask_nonfinite) and self.audit
+        # fused Pallas epilogue (ops/pallas_comm.py): delta-encode +
+        # quantize + EF-residual in one kernel per chunk, and the
+        # apply/correction likewise.  None routes on the shared
+        # lowerable() gate (TPU native); True forces the kernels
+        # (interpreter mode off-TPU — the test/bench pin); False keeps
+        # the unfused jitted closures.  Both paths are bit-identical
+        # by construction (same per-element op order).
+        from sparknet_tpu.ops.pallas_attention import lowerable
+
+        self.fused = lowerable() if fused is None else bool(fused)
 
         # ---- per-round carried state (device, worker-stacked) ----
         # anchor: what deltas are measured against — the round-start
@@ -447,6 +458,11 @@ class CommPlane:
         slices.append(slice(start, len(leaves)))
         self._chunk_slices = [s for s in slices if s.stop > s.start]
         self._payload_bytes_per_round = _RING_FACTOR * total
+        tm = obs.training_metrics()
+        if tm is not None:
+            tm.kernel_path.labels("epilogue").set(
+                1.0 if self.fused else 0.0
+            )
         restore, self._resid_restore = self._resid_restore, None
         if restore is not None:
             # journaled EF residuals restored before the first round
@@ -461,6 +477,99 @@ class CommPlane:
             self._resid = [jnp.asarray(r) for r in restore]
         else:
             self._resid = [jnp.zeros_like(x) for x in leaves]
+
+    # ------------------------------------------------------------------
+    # epilogue routing: the same three program contracts as the jitted
+    # unfused closures, but one Pallas kernel per comm chunk on the
+    # fused path (ops/pallas_comm.py) — delta + quantize + EF residual
+    # (and dequant + apply + anchor) each a single pass over the chunk
+    # instead of an op chain round-tripping full-model intermediates
+    # through HBM.  Bit-identical by construction; routing is decided
+    # once at __init__ (self.fused).
+    def _count_fused(self, stage: str) -> None:
+        tm = obs.training_metrics()
+        if tm is not None:
+            tm.kernel_fused_chunks.labels(stage).inc(
+                len(self._chunk_slices)
+            )
+
+    def _encode_all(self, leaves, with_err):
+        if not self.fused:
+            idx = tuple(range(len(leaves)))
+            return self._encode(
+                tuple(leaves), tuple(self._anchor), tuple(self._resid),
+                idx, with_err,
+            )
+        from sparknet_tpu.ops import pallas_comm
+
+        qs: list = []
+        scales: list = []
+        new_resids: list = []
+        errs: list = []
+        for sl in self._chunk_slices:
+            q, sc, nr, err = pallas_comm.fused_encode(
+                tuple(leaves[sl]), tuple(self._anchor[sl]),
+                tuple(self._resid[sl]), self._modes_static[sl],
+                with_err, None,
+            )
+            qs.extend(q)
+            scales.extend(sc)
+            new_resids.extend(nr)
+            if with_err:
+                errs.append(err)
+        self._count_fused("encode")
+        err_out = None
+        if with_err:
+            allv = jnp.stack(errs)  # (chunks, workers, 3)
+            err_out = (
+                jnp.max(allv[..., 0]),
+                jnp.sum(allv[..., 1]),
+                jnp.sum(allv[..., 2]),
+            )
+        return tuple(qs), tuple(scales), tuple(new_resids), err_out
+
+    def _apply_barriered_all(self, leaves, means, alive, bad, denom0):
+        if not self.fused:
+            return self._apply_barriered(
+                tuple(leaves), tuple(self._anchor), tuple(means),
+                tuple(self._resid), alive, bad, denom0,
+            )
+        from sparknet_tpu.ops import pallas_comm
+
+        new_leaves: list = []
+        new_resids: list = []
+        for sl in self._chunk_slices:
+            nl, nr = pallas_comm.fused_apply_barriered(
+                tuple(leaves[sl]), tuple(self._anchor[sl]),
+                tuple(means[sl]), tuple(self._resid[sl]),
+                alive, denom0, None,
+            )
+            new_leaves.extend(nl)
+            new_resids.extend(nr)
+        self._count_fused("apply")
+        return tuple(new_leaves), tuple(new_resids)
+
+    def _apply_correction_all(self, leaves, q, scales, means):
+        if not self.fused:
+            idx = tuple(range(len(leaves)))
+            return self._apply_correction(
+                tuple(leaves), tuple(self._anchor), tuple(q),
+                tuple(scales), tuple(means), idx,
+            )
+        from sparknet_tpu.ops import pallas_comm
+
+        new_leaves: list = []
+        new_anchors: list = []
+        for sl in self._chunk_slices:
+            nl, na = pallas_comm.fused_apply_correction(
+                tuple(leaves[sl]), tuple(self._anchor[sl]),
+                tuple(q[sl]), tuple(scales[sl]), tuple(means[sl]),
+                self._modes_static[sl], None,
+            )
+            new_leaves.extend(nl)
+            new_anchors.extend(na)
+        self._count_fused("apply")
+        return tuple(new_leaves), tuple(new_anchors)
 
     def _comm_leaves(self, state) -> list:
         leaves = list(jax.tree_util.tree_leaves(state.params))
@@ -638,10 +747,8 @@ class CommPlane:
         holder = p["holder"]
         with obs.span("dequantize", stage=stage):
             leaves = self._comm_leaves(state)
-            idx = tuple(range(len(leaves)))
-            new_leaves, new_anchor = self._apply_correction(
-                tuple(leaves), tuple(self._anchor), tuple(p["q"]),
-                tuple(p["scales"]), tuple(holder["means"]), idx,
+            new_leaves, new_anchor = self._apply_correction_all(
+                leaves, p["q"], p["scales"], holder["means"]
             )
             state = self._rebuild(state, list(new_leaves))
             self._anchor = list(new_anchor)
@@ -734,7 +841,6 @@ class CommPlane:
 
         # ---- encode this round's deltas ----
         leaves = self._comm_leaves(state)
-        idx = tuple(range(len(leaves)))
         # per-round quantization-error telemetry (delta max-abs-err +
         # SNR, labeled by compress mode like the payload family): the
         # PR-6 bit-accuracy band, observable in LIVE runs.  The
@@ -750,10 +856,7 @@ class CommPlane:
         tm = obs.training_metrics()
         with_err = tm is not None and self.compress != "none"
         with obs.span("quantize", compress=self.compress):
-            q, scales, new_resid, err = self._encode(
-                tuple(leaves), tuple(self._anchor), tuple(self._resid),
-                idx, with_err,
-            )
+            q, scales, new_resid, err = self._encode_all(leaves, with_err)
         q, scales = list(q), list(scales)
         self._resid = list(new_resid)
 
@@ -797,10 +900,8 @@ class CommPlane:
             if holder.get("error") is not None:
                 raise holder["error"]
             with obs.span("dequantize", stage="barriered"):
-                new_leaves, new_resid2 = self._apply_barriered(
-                    tuple(leaves), tuple(self._anchor),
-                    tuple(holder["means"]), tuple(self._resid),
-                    alive, bad, holder["denom0"],
+                new_leaves, new_resid2 = self._apply_barriered_all(
+                    leaves, holder["means"], alive, bad, holder["denom0"]
                 )
                 self._resid = list(new_resid2)
                 history = state.history

@@ -54,15 +54,78 @@ def seq_shmap_kwargs() -> dict:
     return dict(_SHMAP_KW)
 
 
-def ring_attention(q, k, v, axis_name: str, causal: bool = False):
+def _merge_partials(o1, lse1, o2, lse2):
+    """Online-softmax combine of two partial attentions over disjoint
+    key sets: ``(o, lse)`` each normalized within its own keys, lse the
+    row logsumexp ( -inf == no visible keys).  Differentiable — every
+    -inf/0 leg is guarded so no NaN survives into either the value or
+    the cotangent path."""
+    m = jnp.maximum(lse1, lse2)
+    m_safe = jnp.where(m == -jnp.inf, 0.0, m)
+    w1 = jnp.exp(lse1 - m_safe)  # exp(-inf) = 0: absent side drops out
+    w2 = jnp.exp(lse2 - m_safe)
+    den = w1 + w2
+    den_safe = jnp.maximum(den, 1e-30)
+    o = (w1[..., None] * o1 + w2[..., None] * o2) / den_safe[..., None]
+    lse = jnp.where(den > 0, m_safe + jnp.log(den_safe), -jnp.inf)
+    return o, lse
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   use_flash=None):
     """Attention over ring-sharded KV. Call under shard_map; q/k/v are the
-    local shards (B, T_local, H, D); returns the local output shard."""
+    local shards (B, T_local, H, D); returns the local output shard.
+
+    ``use_flash``: the per-shard local attention of each ring step runs
+    through the Pallas flash kernel (``ops.pallas_attention.
+    flash_attention_step`` — absolute-position causal mask, (o, lse)
+    merged with the online-softmax combine, exact gradients via the
+    kernel's custom_vjp).  ``None`` takes the kernel wherever it lowers
+    natively (``pallas_attention.lowerable()``); ``True`` forces it
+    (interpreter mode off-TPU — the test/bench pin), ``False`` keeps
+    the einsum path (``--dense_attention``)."""
+    from sparknet_tpu.ops import pallas_attention
+
+    if use_flash is None:
+        use_flash = pallas_attention.lowerable()
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     b, tq, h, d = q.shape
     tk = k.shape[1]
     scale = 1.0 / math.sqrt(d)
     perm = [(j, (j + 1) % n) for j in range(n)]
+
+    if use_flash:
+        def flash_step(i, o_acc, lse_acc, k_cur, v_cur):
+            src = (idx - i) % n  # whose KV shard we hold at ring step i
+            o_s, lse_s = pallas_attention.flash_attention_step(
+                q, k_cur, v_cur,
+                q_offset=idx * tq, k_offset=src * tk, causal=causal,
+            )
+            return _merge_partials(
+                o_acc, lse_acc, o_s.astype(o_acc.dtype), lse_s
+            )
+
+        def flash_body(i, carry):
+            o_acc, lse_acc, k_cur, v_cur = carry
+            o_acc, lse_acc = flash_step(i, o_acc, lse_acc, k_cur, v_cur)
+            k_next = lax.ppermute(k_cur, axis_name, perm)
+            v_next = lax.ppermute(v_cur, axis_name, perm)
+            return o_acc, lse_acc, k_next, v_next
+
+        o_acc = _pcast(
+            jnp.zeros((b, h, tq, d), jnp.float32), axis_name, to="varying"
+        )
+        lse_acc = _pcast(
+            jnp.full((b, h, tq), -jnp.inf, jnp.float32),
+            axis_name, to="varying",
+        )
+        o_acc, lse_acc, k_last, v_last = lax.fori_loop(
+            0, n - 1, flash_body, (o_acc, lse_acc, k, v)
+        )
+        o_acc, _ = flash_step(n - 1, o_acc, lse_acc, k_last, v_last)
+        return jnp.transpose(o_acc, (0, 2, 1, 3)).astype(q.dtype)
+
     q_pos = idx * tq + jnp.arange(tq)  # global query positions
 
     def accumulate(i, acc, m, l, k_cur, v_cur):
@@ -106,7 +169,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
 
 
 def ring_self_attention(
-    mesh: Mesh, axis: str = "sp", causal: bool = False
+    mesh: Mesh, axis: str = "sp", causal: bool = False, use_flash=None
 ):
     """Returns a fn (q, k, v) -> out with q/k/v (B, T, H, D) sharded
     along T over ``axis``; the driver-facing wrapper.  T must divide
@@ -125,7 +188,8 @@ def ring_self_attention(
         **_SHMAP_KW,
     )
     def inner(q, k, v):
-        return ring_attention(q, k, v, axis, causal=causal)
+        return ring_attention(q, k, v, axis, causal=causal,
+                              use_flash=use_flash)
 
     def fn(q, k, v):
         for name, arr in (("q", q), ("k", k), ("v", v)):

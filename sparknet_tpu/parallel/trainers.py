@@ -231,6 +231,7 @@ class ParameterAveragingTrainer:
         comm_chunks: Optional[int] = None,
         overlap_steps: Optional[int] = None,
         comm_cost_ms_per_mb: Optional[float] = None,
+        comm_fused: Optional[bool] = None,
         hierarchy: Optional[HierarchySpec] = None,
         batch_spec=None,
     ):
@@ -335,6 +336,10 @@ class ParameterAveragingTrainer:
                 average_stats=average_stats,
                 mask_nonfinite=mask_nonfinite,
                 batch_spec=batch_spec,
+                # fused Pallas epilogue routing (None = the shared
+                # lowerable() gate; True forces the kernels, the
+                # KERNELS_r21 A/B lever)
+                fused=comm_fused,
             )
         self._fused_payload_bytes: Optional[int] = None
 

@@ -186,3 +186,145 @@ def test_ring_attention_check_rep_backport():
         jax.grad(lambda q: jnp.sum(jnp.square(fn(q, k, v))))
     )(q)
     assert np.all(np.isfinite(np.asarray(g)))
+
+
+# ---------------------------------------------------------------------
+# flash backward (custom_vjp): grads pinned against jax.grad of the
+# dense reference — the training-step default rides this kernel pair
+
+
+def _flash_loss(q, k, v, causal, block_q=8):
+    out = flash_attention(q, k, v, causal=causal, block_q=block_q)
+    return jnp.sum(jnp.square(out.astype(jnp.float32)))
+
+
+def _dense_loss(q, k, v, causal):
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    return jnp.sum(jnp.square(mha_reference(qf, kf, vf, causal=causal)))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_backward_matches_dense(causal):
+    q, k, v = _qkv(7)
+    for wrt in (0, 1, 2):  # dq, dk, dv
+        g = jax.grad(_flash_loss, argnums=wrt)(q, k, v, causal)
+        ref = jax.grad(_dense_loss, argnums=wrt)(q, k, v, causal)
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(ref), atol=5e-5
+        )
+
+
+@pytest.mark.parametrize("tq,heads", [(5, 4), (13, 3), (29, 2)])
+def test_flash_ragged_query_fwd_and_grad(tq, heads):
+    """T_q not divisible by block_q auto-pads (mask-correct) instead of
+    raising — forward AND backward, odd head counts included."""
+    rng = np.random.RandomState(20 + tq)
+    q = jnp.asarray(rng.randn(2, tq, heads, 16).astype(np.float32))
+    k = jnp.asarray(rng.randn(2, tq, heads, 16).astype(np.float32))
+    v = jnp.asarray(rng.randn(2, tq, heads, 16).astype(np.float32))
+    for causal in (False, True):
+        out = flash_attention(q, k, v, causal=causal, block_q=8)
+        ref = mha_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5
+        )
+        g = jax.grad(_flash_loss)(q, k, v, causal)
+        ref_g = jax.grad(_dense_loss)(q, k, v, causal)
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(ref_g), atol=5e-5
+        )
+
+
+def test_flash_causal_convention_end_aligned():
+    """T_q < T_k uses the END-aligned causal convention — row i of the
+    query block sits at absolute position (tk - tq) + i, exactly
+    ``mha_reference``'s ``tril(k=tk-tq)`` — forward and grads."""
+    rng = np.random.RandomState(11)
+    tq, tk = 8, 32
+    q = jnp.asarray(rng.randn(2, tq, 4, 16).astype(np.float32))
+    k = jnp.asarray(rng.randn(2, tk, 4, 16).astype(np.float32))
+    v = jnp.asarray(rng.randn(2, tk, 4, 16).astype(np.float32))
+    out = flash_attention(q, k, v, causal=True, block_q=8)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    for wrt in (0, 1, 2):
+        g = jax.grad(_flash_loss, argnums=wrt)(q, k, v, True)
+        ref_g = jax.grad(_dense_loss, argnums=wrt)(q, k, v, True)
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(ref_g), atol=5e-5
+        )
+
+
+def test_flash_bf16_within_pinned_tolerance():
+    """bf16 inputs: fp32-accumulated kernel stays within the pinned
+    band of the fp32 dense reference, forward (4e-2) and grads (6e-2),
+    and the output keeps the input dtype."""
+    q, k, v = (x.astype(jnp.bfloat16) for x in _qkv(12))
+    out = flash_attention(q, k, v, causal=True, block_q=8)
+    assert out.dtype == jnp.bfloat16
+    ref = mha_reference(
+        *(x.astype(jnp.float32) for x in (q, k, v)), causal=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), atol=4e-2
+    )
+    g = jax.grad(_flash_loss)(q, k, v, True)
+    assert g.dtype == jnp.bfloat16
+    ref_g = jax.grad(_dense_loss)(q, k, v, True)
+    np.testing.assert_allclose(
+        np.asarray(g, np.float32), np.asarray(ref_g), atol=6e-2
+    )
+
+
+def test_flash_rejects_empty_query():
+    q = jnp.zeros((2, 0, 4, 16), jnp.float32)
+    k, v = (jnp.zeros((2, 8, 4, 16), jnp.float32) for _ in range(2))
+    with pytest.raises(ValueError, match="T_q=0"):
+        flash_attention(q, k, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_matches_dense_ring(causal):
+    """The per-shard flash path inside ring attention (use_flash=True,
+    interpret on CPU) matches the einsum ring AND the dense reference —
+    forward and q/k/v grads (the sp training path's contract)."""
+    mesh = make_mesh({"sp": 4}, devices=jax.devices()[:4])
+    q, k, v = _qkv(9)
+    fn = ring_self_attention(mesh, "sp", causal=causal, use_flash=True)
+    out = fn(q, k, v)
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    for wrt in (0, 1, 2):
+        g = jax.grad(
+            lambda *a: jnp.sum(jnp.square(fn(*a))), argnums=wrt
+        )(q, k, v)
+        ref_g = jax.grad(
+            lambda *a: jnp.sum(
+                jnp.square(mha_reference(*a, causal=causal))
+            ),
+            argnums=wrt,
+        )(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(ref_g), atol=5e-4
+        )
+
+
+def test_flash_jitted_step_zero_post_warmup_recompiles():
+    """Sanitizer: the kernel inside a jitted value_and_grad step
+    compiles ONCE — repeated same-shape steps with fresh data hit the
+    cache (recompiles after warmup == 0)."""
+
+    @jax.jit
+    def step(q, k, v):
+        return jax.value_and_grad(
+            lambda q: _flash_loss(q, k, v, True)
+        )(q)
+
+    step(*_qkv(14))  # warmup compile
+    warm = step._cache_size()
+    assert warm == 1
+    for seed in (15, 16, 17):
+        loss, g = step(*_qkv(seed))
+        assert np.isfinite(float(loss))
+        assert np.all(np.isfinite(np.asarray(g)))
+    assert step._cache_size() - warm == 0

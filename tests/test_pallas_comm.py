@@ -1,0 +1,269 @@
+"""Fused averaging-epilogue kernel tests (``ops/pallas_comm.py``).
+
+The contract under test is BIT-IDENTITY: each fused kernel (interpret
+mode on CPU) must reproduce the comm plane's jitted unfused closure
+exactly — same op order, same rounding, down to the last ULP — for
+every compress mode, so flipping ``CommPlane(fused=...)`` can never
+move a training trajectory.  Both sides are compared JITTED: XLA
+rewrites ``x / 127.0`` into multiply-by-reciprocal only inside jit, so
+an eager reference would differ from both real paths by 1 ULP.
+
+Three layers:
+- kernel vs jitted reference op-chain (per mode, mixed-mode chunks,
+  the with_err SNR readout, dead-worker/rejoin and no-survivor legs),
+- a real ``ParameterAveragingTrainer`` A/B: ``comm_fused=True`` (Pallas
+  interpret) against ``comm_fused=False`` over multiple rounds —
+  barriered AND overlapped schedules — final params bitwise equal,
+- routing: ``fused=None`` resolves through the shared
+  ``pallas_attention.lowerable()`` gate, and the fused path drives the
+  ``sparknet_kernel_path`` / ``sparknet_kernel_fused_chunks_total``
+  telemetry.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from sparknet_tpu import obs
+from sparknet_tpu.ops import pallas_comm
+from sparknet_tpu.ops.pallas_attention import lowerable
+from sparknet_tpu.parallel import (
+    ParameterAveragingTrainer,
+    make_mesh,
+    shard_leading,
+)
+
+from tests.test_parallel import _data, _solver
+
+W = 4  # worker-leading dim on every comm leaf
+
+
+@pytest.fixture(autouse=True)
+def _reset_obs():
+    yield
+    obs._reset_training_metrics_for_tests()
+
+
+def _leaves(seed=0, shapes=((3, 5), (7,), (2, 2, 4))):
+    rng = np.random.RandomState(seed)
+    return tuple(
+        jnp.asarray(rng.randn(W, *s).astype(np.float32)) for s in shapes
+    )
+
+
+def _ref_encode(leaves, anchors, resids, modes, with_err):
+    # the unfused closure's exact op order (comm.py encode_fn), jitted
+    def fn(leaves, anchors, resids):
+        qs, scales, new_resids = [], [], []
+        max_abs = jnp.zeros(())
+        err_sq = jnp.zeros(())
+        delta_sq = jnp.zeros(())
+        for x, a, r, mode in zip(leaves, anchors, resids, modes):
+            delta = (x - a) + r
+            zero_scale = jnp.zeros((x.shape[0],), jnp.float32)
+            if mode == "bf16":
+                q = delta.astype(jnp.bfloat16)
+                scale = zero_scale
+                dq = q.astype(jnp.float32)
+            elif mode == "int8":
+                red = tuple(range(1, delta.ndim))
+                amax = jnp.max(jnp.abs(delta), axis=red)
+                scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+                sc = scale.reshape((-1,) + (1,) * (delta.ndim - 1))
+                q = jnp.clip(jnp.rint(delta / sc), -127, 127).astype(
+                    jnp.int8
+                )
+                dq = q.astype(jnp.float32) * sc
+            else:
+                q = delta
+                scale = zero_scale
+                dq = q
+            err = delta - dq
+            qs.append(q)
+            scales.append(scale)
+            new_resids.append(err)
+            if with_err:
+                max_abs = jnp.maximum(max_abs, jnp.max(jnp.abs(err)))
+                err_sq = err_sq + jnp.sum(jnp.square(err))
+                delta_sq = delta_sq + jnp.sum(jnp.square(delta))
+        err_out = (max_abs, delta_sq, err_sq) if with_err else None
+        return tuple(qs), tuple(scales), tuple(new_resids), err_out
+
+    return jax.jit(fn)(leaves, anchors, resids)
+
+
+@pytest.mark.parametrize(
+    "modes",
+    [
+        ("fp32", "fp32", "fp32"),
+        ("bf16", "bf16", "bf16"),
+        ("int8", "int8", "int8"),
+        ("int8", "fp32", "bf16"),  # a mixed chunk (params + stats tail)
+    ],
+    ids=["fp32", "bf16", "int8", "mixed"],
+)
+def test_fused_encode_bitwise(modes):
+    leaves = _leaves(0)
+    anchors = _leaves(1)
+    resids = _leaves(2)
+    got = pallas_comm.fused_encode(
+        leaves, anchors, resids, modes, False, True
+    )
+    ref = _ref_encode(leaves, anchors, resids, modes, False)
+    for g, r in zip(got[0], ref[0]):  # q payloads, dtype included
+        assert g.dtype == r.dtype
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
+    for g, r in zip(got[1], ref[1]):  # per-tensor scales
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
+    for g, r in zip(got[2], ref[2]):  # error-feedback residuals
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
+    assert got[3] is None
+
+
+def test_fused_encode_err_readout_matches():
+    """with_err folds the SNR readout (max|err|, |delta|^2, |err|^2)
+    into the same kernel pass; the combined scalars must equal the
+    unfused closure's reductions (int8 so err is nonzero)."""
+    modes = ("int8", "int8", "int8")
+    leaves, anchors, resids = _leaves(3), _leaves(4), _leaves(5)
+    _, _, _, err = pallas_comm.fused_encode(
+        leaves, anchors, resids, modes, True, True
+    )
+    assert err is not None and err.shape == (W, 3)
+    got = (
+        float(jnp.max(err[:, 0])),
+        float(jnp.sum(err[:, 1])),
+        float(jnp.sum(err[:, 2])),
+    )
+    _, _, _, ref = _ref_encode(leaves, anchors, resids, modes, True)
+    assert got[0] == float(ref[0])
+    np.testing.assert_allclose(got[1], float(ref[1]), rtol=1e-6)
+    np.testing.assert_allclose(got[2], float(ref[2]), rtol=1e-6)
+    assert got[2] > 0  # int8 genuinely quantizes on random data
+
+
+def test_fused_apply_barriered_bitwise():
+    """Consensus apply: live workers land on anchor+mean, a dead
+    worker's residual resets on rejoin, and with NO survivors every
+    worker keeps its own params (the host-sentry contract)."""
+    leaves, anchors, resids = _leaves(6), _leaves(7), _leaves(8)
+    means = tuple(x[0] for x in _leaves(9))  # means are unsharded
+    alive = jnp.asarray([1.0, 0.0, 1.0, 1.0])
+
+    def ref(leaves, anchors, means, resids, alive, denom0):
+        have = denom0 > 0
+        rejoin = jnp.logical_and(alive <= 0, have)
+        nl, nr = [], []
+        for x, a, m, r in zip(leaves, anchors, means, resids):
+            rm = rejoin.reshape((-1,) + (1,) * (x.ndim - 1))
+            nl.append(jnp.where(have, a + m, x))
+            nr.append(jnp.where(rm, jnp.zeros_like(r), r))
+        return tuple(nl), tuple(nr)
+
+    for denom0 in (jnp.asarray(3.0), jnp.asarray(0.0)):
+        got = pallas_comm.fused_apply_barriered(
+            leaves, anchors, means, resids, alive, denom0, True
+        )
+        want = jax.jit(ref)(leaves, anchors, means, resids, alive, denom0)
+        for g, r in zip(got[0] + got[1], want[0] + want[1]):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
+
+
+def test_fused_apply_correction_bitwise():
+    """Overlapped apply: params AND anchors advance by the consensus-
+    minus-own-contribution correction, dequant included."""
+    modes = ("int8", "bf16", "fp32")
+    leaves, anchors, resids = _leaves(10), _leaves(11), _leaves(12)
+    qs, scales, _, _ = pallas_comm.fused_encode(
+        leaves, anchors, resids, modes, False, True
+    )
+    means = tuple(x[0] for x in _leaves(13))
+
+    def ref(leaves, anchors, qs, scales, means):
+        nl, na = [], []
+        for x, a, q, scale, m, mode in zip(
+            leaves, anchors, qs, scales, means, modes
+        ):
+            if mode == "int8":
+                sc = scale.reshape((-1,) + (1,) * (q.ndim - 1))
+                dq = q.astype(jnp.float32) * sc
+            elif mode == "bf16":
+                dq = q.astype(jnp.float32)
+            else:
+                dq = q
+            corr = m - dq
+            nl.append(x + corr)
+            na.append(a + corr)
+        return tuple(nl), tuple(na)
+
+    got = pallas_comm.fused_apply_correction(
+        leaves, anchors, qs, scales, means, modes, True
+    )
+    want = jax.jit(ref)(leaves, anchors, qs, scales, means)
+    for g, r in zip(got[0] + got[1], want[0] + want[1]):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
+
+
+# ---------------------------------------------------------------------
+# trainer-level A/B: the whole point — flipping comm_fused must never
+# move the trajectory
+
+
+def _run(fused, rounds=3, **kw):
+    mesh = make_mesh({"dp": 4}, devices=jax.devices()[:4])
+    data = _data(4, 3, seed=5)
+    trainer = ParameterAveragingTrainer(
+        _solver(momentum=0.9), mesh, comm_fused=fused, **kw
+    )
+    st = trainer.init_state(seed=0)
+    for _ in range(rounds):
+        st = trainer.round(st, shard_leading(data, mesh))[0]
+    return trainer, trainer.finalize(st)
+
+
+@pytest.mark.parametrize("compress", ["fp32", "bf16", "int8"])
+def test_trainer_fused_epilogue_bitwise(compress):
+    t, st_ref = _run(False, compress=compress)
+    tf, st = _run(True, compress=compress)
+    assert t._comm is not None and not t._comm.fused
+    assert tf._comm.fused
+    for a, b in zip(
+        jax.tree_util.tree_leaves(st_ref.params),
+        jax.tree_util.tree_leaves(st.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("compress", ["fp32", "int8"])
+def test_trainer_fused_overlap_correction_bitwise(compress):
+    # overlap_avg exercises the fused_apply_correction leg end-to-end
+    _, st_ref = _run(False, compress=compress, overlap_avg=True)
+    _, st = _run(True, compress=compress, overlap_avg=True)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(st_ref.params),
+        jax.tree_util.tree_leaves(st.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fused_routing_and_telemetry():
+    """fused=None resolves via the shared lowerable() gate (False on
+    this CPU suite); forcing it on sets sparknet_kernel_path{epilogue}
+    and counts one fused launch per chunk per stage per round."""
+    tm = obs.enable_training_metrics()
+    t_auto, _ = _run(None, rounds=1, compress="fp32")
+    assert t_auto._comm.fused == lowerable()
+    assert tm.kernel_path.labels("epilogue").value == (
+        1.0 if lowerable() else 0.0
+    )
+    before = tm.kernel_fused_chunks.labels("encode").value
+    rounds = 2
+    t, _ = _run(True, rounds=rounds, compress="int8")
+    nchunks = len(t._comm._chunk_slices)
+    assert tm.kernel_path.labels("epilogue").value == 1.0
+    assert (
+        tm.kernel_fused_chunks.labels("encode").value - before
+        == nchunks * rounds
+    )
+    assert tm.kernel_fused_chunks.labels("apply").value >= nchunks * rounds

@@ -745,3 +745,144 @@ def test_stale_family_rules(tmp_path):
             "stale_slow_round_ms_p50" in r["detail"]
             for r in rows if not r["ok"]
         ), (wc, rows)
+
+
+GOOD_KERNELS = {
+    "value": 3.88, "platform": "cpu",
+    "flash_fwd_max_diff": 3e-7, "flash_fwd_tol": 2e-5,
+    "flash_fwd_ok": True,
+    "flash_grad_max_diff": 1.4e-6, "flash_grad_tol": 5e-5,
+    "flash_grad_ok": True,
+    "flash_ragged_fwd_max_diff": 2.4e-7,
+    "flash_ragged_grad_max_diff": 2.9e-6, "flash_ragged_ok": True,
+    "flash_bf16_fwd_max_diff": 6.2e-3, "flash_bf16_fwd_tol": 4e-2,
+    "flash_bf16_grad_max_diff": 3.1e-2, "flash_bf16_grad_tol": 6e-2,
+    "flash_bf16_ok": True,
+    "ring_flash_max_diff": 2.9e-6, "ring_tolerance": 5e-4,
+    "ring_flash_ok": True,
+    "trainer_ab_bitwise": True, "fused_kernel_launches": 54,
+    "int8_loss_gap": 0.0013, "loss_band": 0.08, "loss_band_ok": True,
+    "post_warmup_recompiles": 0,
+    "attn_hbm_ratio": 3.88, "epilogue_hbm_ratio": 2.24,
+    "wallclock_rules_armed": True, "wallclock_measured": False,
+}
+
+
+def test_kernels_family_rules(tmp_path):
+    """The KERNELS family (ISSUE 18): flash fwd+bwd pinned against the
+    dense reference, ring flash inside the LM tolerance, the fused
+    epilogue bitwise through a real trainer with the int8 loss gap in
+    band, zero post-warmup recompiles, modeled HBM ratios above 1 —
+    any one regressing fails --check."""
+    g = _gate()
+    _write(tmp_path, "KERNELS_r21.json", GOOD_KERNELS)
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 0, [r for r in rows if not r["ok"]]
+    for bad_field, bad_value in (
+        ("flash_fwd_ok", False),          # forward drifted off dense
+        ("flash_grad_ok", False),         # custom_vjp grads drifted
+        ("flash_ragged_ok", False),       # the auto-pad path broke
+        ("flash_bf16_ok", False),         # bf16 out of its band
+        ("ring_flash_ok", False),         # per-shard flash off the ring
+        ("trainer_ab_bitwise", False),    # fused epilogue moved params
+        ("fused_kernel_launches", 0),     # the fused path never ran
+        ("loss_band_ok", False),          # int8 leg out of band
+        ("post_warmup_recompiles", 2),    # kernel retraces in the step
+        ("attn_hbm_ratio", 0.9),          # modeled bytes went backwards
+        ("epilogue_hbm_ratio", 0.8),
+        ("wallclock_rules_armed", False),  # someone disarmed the gate
+    ):
+        _write(
+            tmp_path, "KERNELS_r22.json",
+            dict(GOOD_KERNELS, **{bad_field: bad_value}),
+        )
+        rc, rows = g.check(str(tmp_path))
+        assert rc == 1, bad_field
+        assert any(
+            bad_field in r["detail"] for r in rows if not r["ok"]
+        ), (bad_field, rows)
+    # the pins extra rule: a measured diff past the artifact's OWN pin
+    # fails even with the ok flag mistakenly True
+    for diff_field, pin_field in (
+        ("flash_grad_max_diff", "flash_grad_tol"),
+        ("ring_flash_max_diff", "ring_tolerance"),
+        ("int8_loss_gap", "loss_band"),
+    ):
+        _write(
+            tmp_path, "KERNELS_r22.json",
+            dict(GOOD_KERNELS, **{diff_field: 1.0}),
+        )
+        rc, rows = g.check(str(tmp_path))
+        assert rc == 1, diff_field
+        assert any(
+            diff_field in r["detail"] for r in rows if not r["ok"]
+        ), (diff_field, rows)
+    # a missing diff field is a failure, not a silent pass
+    bad = dict(GOOD_KERNELS)
+    del bad["ring_flash_max_diff"]
+    _write(tmp_path, "KERNELS_r22.json", bad)
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 1
+    # wall-clock: off-chip must DISCLOSE (wallclock_measured False);
+    # an on-chip artifact must actually carry a >1 speedup
+    _write(
+        tmp_path, "KERNELS_r22.json",
+        dict(GOOD_KERNELS, wallclock_measured=True),
+    )
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 1  # CPU artifact claiming a measured wall-clock
+    _write(
+        tmp_path, "KERNELS_r22.json",
+        dict(GOOD_KERNELS, platform="tpu", wallclock_measured=True,
+             wallclock_attn_speedup=2.3),
+    )
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 0, [r for r in rows if not r["ok"]]
+    _write(
+        tmp_path, "KERNELS_r22.json",
+        dict(GOOD_KERNELS, platform="tpu", wallclock_measured=True,
+             wallclock_attn_speedup=0.8),
+    )
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 1
+
+
+def test_kernels_cross_rules(tmp_path):
+    """KERNELS x LM and KERNELS x COMM: the ring-flash diff must sit
+    inside LM's OWN sp_tolerance and the int8 loss gap inside COMM's
+    OWN loss_band — the kernels bench cannot pick itself easier pins
+    than the committed workload artifacts."""
+    g = _gate()
+    good_comm = {
+        "overlap_vs_ideal": 1.04, "bytes_ratio_int8": 4.0,
+        "bytes_ratio_bf16": 2.0, "loss_band_ok": True,
+        "loss_band": 0.08,
+    }
+    _write(tmp_path, "KERNELS_r21.json", GOOD_KERNELS)
+    _write(tmp_path, "LM_r18.json", GOOD_LM)
+    _write(tmp_path, "COMM_r11.json", good_comm)
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 0, [r for r in rows if not r["ok"]]
+    assert any(r["family"] == "KERNELS x LM" for r in rows)
+    assert any(r["family"] == "KERNELS x COMM" for r in rows)
+    # ring diff past the LM pin fails the cross rule (the family's own
+    # ring_tolerance is looser here — exactly the drift being caught)
+    _write(
+        tmp_path, "KERNELS_r21.json",
+        dict(GOOD_KERNELS, ring_flash_max_diff=2e-3, ring_tolerance=1e-2),
+    )
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 1
+    assert any(
+        r["family"] == "KERNELS x LM" and not r["ok"] for r in rows
+    )
+    # loss gap past the COMM band likewise
+    _write(
+        tmp_path, "KERNELS_r21.json",
+        dict(GOOD_KERNELS, int8_loss_gap=0.5, loss_band=1.0),
+    )
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 1
+    assert any(
+        r["family"] == "KERNELS x COMM" and not r["ok"] for r in rows
+    )

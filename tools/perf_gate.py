@@ -308,6 +308,33 @@ RULES: Dict[str, List[Rule]] = {
         Rule("hier_laggiest_ok", "is", True),
         Rule("hier_finite", "is", True),
     ],
+    "KERNELS": [
+        # the Pallas raw-speed pass contract (bench.py --mode=kernels):
+        # flash fwd+bwd pinned against the dense reference in interpret
+        # mode (fp32, bf16, ragged T_q, end-aligned T_q<T_k causal),
+        # the ring flash path within the LM associativity tolerance,
+        # the fused averaging epilogue BITWISE identical to the
+        # unfused trainer with the int8 leg inside the COMM loss band,
+        # zero post-warmup recompiles with the kernel in a jitted
+        # step, and both modeled HBM-bytes ratios above 1 (the
+        # wall-clock rules are the extra rule below: armed, enforced
+        # only on-chip).  The measured-diff-vs-own-pin comparisons are
+        # the extra rule; the LM/COMM cross-checks live in
+        # _cross_rules.
+        Rule("value", ">", 1.0),
+        Rule("flash_fwd_ok", "is", True),
+        Rule("flash_grad_ok", "is", True),
+        Rule("flash_ragged_ok", "is", True),
+        Rule("flash_bf16_ok", "is", True),
+        Rule("ring_flash_ok", "is", True),
+        Rule("trainer_ab_bitwise", "is", True),
+        Rule("fused_kernel_launches", ">", 0),
+        Rule("loss_band_ok", "is", True),
+        Rule("post_warmup_recompiles", "==", 0),
+        Rule("attn_hbm_ratio", ">", 1.0),
+        Rule("epilogue_hbm_ratio", ">", 1.0),
+        Rule("wallclock_rules_armed", "is", True),
+    ],
     "DATACACHE": [
         # the I/O-flat contract: a warm (cache-filled, shuffled-
         # assignment) epoch makes ZERO network fetches and is strictly
@@ -473,6 +500,51 @@ def _genserve_divergence_rule(art: dict) -> Tuple[bool, str]:
     )
 
 
+def _kernels_pins_rule(art: dict) -> Tuple[bool, str]:
+    """Every measured kernel diff must sit inside the artifact's OWN
+    pin, whatever tolerances the bench ran with (the ok flags above
+    must agree with the numbers, not just with themselves)."""
+    pairs = (
+        ("flash_fwd_max_diff", "flash_fwd_tol"),
+        ("flash_grad_max_diff", "flash_grad_tol"),
+        ("flash_ragged_fwd_max_diff", "flash_fwd_tol"),
+        ("flash_ragged_grad_max_diff", "flash_grad_tol"),
+        ("flash_bf16_fwd_max_diff", "flash_bf16_fwd_tol"),
+        ("flash_bf16_grad_max_diff", "flash_bf16_grad_tol"),
+        ("ring_flash_max_diff", "ring_tolerance"),
+        ("int8_loss_gap", "loss_band"),
+    )
+    bad = []
+    for mk, tk in pairs:
+        m, t = art.get(mk), art.get(tk)
+        if m is None or t is None or not (0 <= m <= t):
+            bad.append("%s=%r vs %s=%r" % (mk, m, tk, t))
+    return not bad, (
+        "all measured diffs inside the artifact's own pins"
+        if not bad else "out of pin: " + "; ".join(bad)
+    )
+
+
+def _kernels_wallclock_rule(art: dict) -> Tuple[bool, str]:
+    """Wall-clock speedup rules: ARMED everywhere, enforced only for an
+    artifact measured on-chip — an interpret-mode CPU record discloses
+    itself (wallclock_measured false) and skips, it does not fake a
+    speedup."""
+    if art.get("platform") != "tpu":
+        ok = art.get("wallclock_measured") is False
+        return ok, (
+            "off-chip artifact (platform=%r): wall-clock rules armed "
+            "but skipped, wallclock_measured=%r"
+            % (art.get("platform"), art.get("wallclock_measured"))
+        )
+    spd = art.get("wallclock_attn_speedup")
+    ok = bool(
+        art.get("wallclock_measured") is True
+        and spd is not None and spd > 1.0
+    )
+    return ok, "on-chip: wallclock_attn_speedup=%r > 1.0" % (spd,)
+
+
 _EXTRA_RULES = {
     "CHAOS": [_chaos_survival_rule],
     "PIPELINE": [_pipeline_order_rule],
@@ -481,6 +553,7 @@ _EXTRA_RULES = {
     "STALE": [_stale_wallclock_rule],
     "LM": [_lm_tolerance_rule],
     "GENSERVE": [_genserve_kv_rule, _genserve_divergence_rule],
+    "KERNELS": [_kernels_pins_rule, _kernels_wallclock_rule],
 }
 
 
@@ -501,6 +574,30 @@ def _cross_rules(arts: Dict[str, dict]) -> List[Tuple[str, bool, str]]:
                 "live hidden_frac_h2d_p50=%r >= overlap_efficiency-%.2f"
                 "=%.3f" % (live, HIDDEN_FRACTION_BAND, floor),
             ))
+    kern = arts.get("KERNELS")
+    lm = arts.get("LM")
+    if kern is not None and lm is not None:
+        # the ring flash path must sit inside the LM artifact's OWN
+        # associativity tolerance — the sp training run's pin, not a
+        # band the kernels bench picked for itself
+        diff, tol = kern.get("ring_flash_max_diff"), lm.get("sp_tolerance")
+        out.append((
+            "KERNELS x LM",
+            bool(tol is not None and diff is not None
+                 and 0 <= diff <= tol),
+            "ring_flash_max_diff=%r <= LM sp_tolerance=%r" % (diff, tol),
+        ))
+    comm = arts.get("COMM")
+    if kern is not None and comm is not None:
+        # the fused int8 leg's loss gap must sit inside the COMM
+        # artifact's committed band (same cifar10_quick protocol)
+        gap, band = kern.get("int8_loss_gap"), comm.get("loss_band")
+        out.append((
+            "KERNELS x COMM",
+            bool(band is not None and gap is not None
+                 and 0 <= gap <= band),
+            "int8_loss_gap=%r <= COMM loss_band=%r" % (gap, band),
+        ))
     return out
 
 
