@@ -109,7 +109,7 @@ CANONICAL_METRICS = {
     "sparknet_kv_alloc_total": (),
     "sparknet_kv_free_total": (),
     "sparknet_gen_streams_total": (),
-    "sparknet_gen_streams_shed_total": (),
+    "sparknet_gen_streams_shed_total": ("cause",),
     "sparknet_gen_stream_errors_total": (),
     "sparknet_gen_tokens_total": (),
     "sparknet_gen_active_streams": (),
@@ -118,6 +118,13 @@ CANONICAL_METRICS = {
     "sparknet_gen_decode_batch_occupancy": (),
     "sparknet_gen_jit_cache_size": (),
     "sparknet_gen_resumes_total": (),
+    # request anatomy (obs/reqtrace.py RequestProfiler) — per-stage
+    # latency folds + the window's bound-stage / slow-replica verdicts
+    "sparknet_req_stage_seconds": ("stage",),
+    "sparknet_req_bound_stage": (),
+    "sparknet_req_replica_skew": (),
+    "sparknet_req_slow_replica": (),
+    "sparknet_req_completed_total": (),
     # bounded-staleness averaging (parallel/stale.py, --stale_bound) —
     # per-worker lag/arrival accounting at each averaging boundary
     "sparknet_staleness": ("worker",),
@@ -154,6 +161,13 @@ CANONICAL_SPANS = {
     # generation serving (serve/generate.py): the two jitted steps of
     # the prefill/decode disaggregation
     "gen": frozenset({"prefill", "decode_step"}),
+    # request anatomy (obs/reqtrace.py + serve instrumentation): the
+    # per-request lifecycle spans the RequestProfiler folds — a
+    # "request" lifetime envelope around queue_wait -> kv_reserve ->
+    # (gen) prefill/decode_step -> stream_write per chunk
+    "req": frozenset({
+        "request", "queue_wait", "kv_reserve", "stream_write",
+    }),
 }
 
 # the comm-plane span triple tools/trace_report.py folds into its

@@ -25,7 +25,9 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from sparknet_tpu.obs import reqtrace as _reqtrace
 from sparknet_tpu.obs.metrics import MetricsRegistry
+from sparknet_tpu.obs.trace import span
 from sparknet_tpu.serve.engine import InferenceEngine
 
 
@@ -299,9 +301,11 @@ class GenStream:
     __slots__ = (
         "prompt", "max_new", "engine", "blocks", "events", "tokens",
         "logprobs", "t_submit", "t_first", "t_last", "slot", "finished",
+        "rid", "_sp_queue", "_sp_request",
     )
 
-    def __init__(self, prompt: List[int], max_new: int, engine, blocks):
+    def __init__(self, prompt: List[int], max_new: int, engine, blocks,
+                 rid: Optional[str] = None):
         self.prompt = prompt
         self.max_new = max_new
         self.engine = engine  # pinned at submit: hot swaps never move a stream
@@ -314,6 +318,12 @@ class GenStream:
         self.t_last: Optional[float] = None
         self.slot: Optional[int] = None
         self.finished = False
+        # request-trace state: the admission-minted id plus the two
+        # cross-thread spans (opened on the submit thread, closed on the
+        # worker — the pattern trace.py's _Span supports by design)
+        self.rid = rid
+        self._sp_queue = None
+        self._sp_request = None
 
     def iter_events(self, timeout: Optional[float] = 60.0):
         """Yield events until (and including) the terminal one.  A
@@ -361,9 +371,13 @@ class StreamBatcher:
         engine,
         max_queue: int = 64,
         metrics: Optional[MetricsRegistry] = None,
+        replica: Optional[int] = None,
     ):
         self.engine = engine
         self.max_queue = int(max_queue)
+        # fleet replica index (None standalone) — rides every request
+        # span so the profiler can attribute per-replica skew
+        self.replica = replica
         self._q: deque = deque()
         self._lock = threading.Lock()
         self._nonempty = threading.Condition(self._lock)
@@ -381,8 +395,9 @@ class StreamBatcher:
         )
         self.m_shed = m.counter(
             "sparknet_gen_streams_shed_total",
-            "streams refused at admission (queue or KV-block budget — "
-            "HTTP 429)",
+            "streams refused at admission, by cause (queue_full, "
+            "kv_reserve, draining — HTTP 429/503)",
+            labels=("cause",),
         )
         self.m_tokens = m.counter(
             "sparknet_gen_tokens_total", "tokens generated and emitted"
@@ -425,29 +440,54 @@ class StreamBatcher:
     # ------------------------------------------------------------------
     # Client side
     # ------------------------------------------------------------------
-    def submit_stream(self, prompt: Sequence[int], max_new: int) -> GenStream:
+    def submit_stream(self, prompt: Sequence[int], max_new: int,
+                      rid: Optional[str] = None) -> GenStream:
         """Admit one generation stream (non-blocking — consume the
         returned handle's events).  Raises ``ValueError`` on geometry
         (400 upstream), ``QueueFull``/``KVBudgetExceeded`` on shed
-        (429), ``RuntimeError`` when stopped or draining (503)."""
+        (429), ``RuntimeError`` when stopped or draining (503).
+
+        ``rid`` is the request id minted upstream (HTTP handler or
+        router); with tracing on and no id given, one is minted here so
+        direct callers get request anatomy too.  Every refusal lands on
+        the ``cause=``-labeled shed counter and a ``shed`` instant."""
         eng = self.engine
         prompt = [int(t) for t in prompt]
         max_new = int(max_new)
         eng.validate(len(prompt), max_new)
+        rid = _reqtrace.maybe_rid(rid)
         with self._lock:
             if not self._running or self._draining:
+                self.m_shed.labels("draining").inc()
+                _reqtrace.note_shed("draining", rid=rid,
+                                    replica=self.replica)
                 raise RuntimeError("batcher is stopped or draining")
             if len(self._q) >= self.max_queue:
-                self.m_shed.inc()
+                self.m_shed.labels("queue_full").inc()
+                _reqtrace.note_shed("queue_full", rid=rid,
+                                    replica=self.replica)
                 raise QueueFull(
                     f"stream queue at capacity ({self.max_queue})"
                 )
             try:
-                blocks = eng.reserve(len(prompt), max_new)
+                blocks = eng.reserve(len(prompt), max_new, rid=rid)
             except QueueFull:  # KVBudgetExceeded included
-                self.m_shed.inc()
+                self.m_shed.labels("kv_reserve").inc()
+                _reqtrace.note_shed("kv_reserve", rid=rid,
+                                    replica=self.replica)
                 raise
-            st = GenStream(prompt, max_new, eng, blocks)
+            st = GenStream(prompt, max_new, eng, blocks, rid=rid)
+            if rid is not None:
+                # open the lifetime + queue-wait spans on the submit
+                # thread; the worker closes them (queue_wait at slot
+                # admit, request at the terminal event)
+                args = {"req": rid}
+                if self.replica is not None:
+                    args["replica"] = self.replica
+                st._sp_request = span("request", cat="req", **args)
+                st._sp_request.__enter__()
+                st._sp_queue = span("queue_wait", cat="req", **args)
+                st._sp_queue.__enter__()
             self._q.append(st)
             self.m_streams.inc()
             self._nonempty.notify()
@@ -466,6 +506,18 @@ class StreamBatcher:
         st.finished = True
         if ev["event"] == "error":
             self.m_errors.inc()
+        sp = st._sp_queue  # stream shed/errored before slot admit
+        if sp is not None:
+            st._sp_queue = None
+            sp.__exit__(None, None, None)
+        sp = st._sp_request
+        if sp is not None:
+            st._sp_request = None
+            args = getattr(sp, "args", None)
+            if args is not None:
+                args["outcome"] = ev["event"]
+                args["tokens"] = len(st.tokens)
+            sp.__exit__(None, None, None)
         st.events.put(ev)
 
     def _emit_token(self, st: GenStream, tok: int, lp: float) -> None:
@@ -509,9 +561,13 @@ class StreamBatcher:
                     st = self._q.popleft()
             if st is None:
                 return admitted
+            sp = st._sp_queue  # queue wait ends as prefill begins
+            if sp is not None:
+                st._sp_queue = None
+                sp.__exit__(None, None, None)
             try:
                 slot, tok, lp = st.engine.admit(
-                    st.prompt, st.max_new, blocks=st.blocks
+                    st.prompt, st.max_new, blocks=st.blocks, rid=st.rid
                 )
             except BaseException as e:  # noqa: BLE001 — becomes an event
                 try:

@@ -50,6 +50,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from sparknet_tpu.obs import reqtrace as _reqtrace
 from sparknet_tpu.obs.metrics import MetricsRegistry
 from sparknet_tpu.serve.batcher import MicroBatcher, QueueFull, StreamBatcher
 from sparknet_tpu.serve.engine import InferenceEngine
@@ -89,7 +90,9 @@ class Replica:
         # queue_depth, drain, stop, _running/_worker, engine attribute —
         # is the shared batcher surface, so the fleet contracts compose
         self.batcher = (
-            StreamBatcher(engine, max_queue=max_queue)
+            # replica=index tags every request span this batcher opens,
+            # so the request profiler can name the slow replica
+            StreamBatcher(engine, max_queue=max_queue, replica=index)
             if self.stream
             else MicroBatcher(
                 engine, max_queue=max_queue, max_wait_ms=max_wait_ms
@@ -531,12 +534,14 @@ class Router:
             )
             return best
 
-    def _admit(self) -> None:
+    def _admit(self, rid: Optional[str] = None) -> None:
         with self._lock:
             if self._draining:
+                _reqtrace.note_shed("draining", rid=rid)
                 raise RuntimeError("router is draining")
             if self._total_inflight >= self.max_inflight:
                 self.m_shed.inc()
+                _reqtrace.note_shed("queue_full", rid=rid)
                 raise QueueFull(
                     "fleet admission bound reached "
                     f"({self.max_inflight} in flight)"
@@ -603,7 +608,8 @@ class Router:
 
     # ------------------------------------------------------------------
     # streaming generation (stream=True pools)
-    def submit_stream(self, prompt, max_new: int, timeout: float = 120.0):
+    def submit_stream(self, prompt, max_new: int, timeout: float = 120.0,
+                      rid: Optional[str] = None):
         """Route one generation stream; yields token events and exactly
         one terminal event (``done``/``stopped``/``error``).
 
@@ -617,7 +623,8 @@ class Router:
         and the client never sees the seam (``decode_replica_kill``
         chaos fault).  Finished streams canary-mirror every k-th via
         per-token logprob scoring."""
-        self._admit()
+        rid = _reqtrace.maybe_rid(rid)
+        self._admit(rid)
         t0 = time.perf_counter()
         try:
             prompt = [int(t) for t in prompt]
@@ -645,8 +652,11 @@ class Router:
                 err = None
                 try:
                     try:
+                        # the resume path reuses the SAME rid: the
+                        # re-prefill on a sibling folds into one request
                         st = rep.batcher.submit_stream(
-                            prompt + tokens, max_new - len(tokens)
+                            prompt + tokens, max_new - len(tokens),
+                            rid=rid,
                         )
                     except QueueFull:
                         self.m_shed.inc()

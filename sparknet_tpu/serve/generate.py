@@ -123,6 +123,9 @@ class GenerationEngine:
             [] for _ in range(self.max_streams)
         ]
         self._active = [False] * self.max_streams
+        # request id occupying each slot (None untraced) — decode_step
+        # spans carry the active set's ids for per-request attribution
+        self._slot_rids: List[Optional[str]] = [None] * self.max_streams
         self._lock = threading.Lock()
 
         def _prefill(params, tokens, last, idx, ak, av):
@@ -233,14 +236,20 @@ class GenerationEngine:
             )
         self.bucket_for(prompt_len)
 
-    def reserve(self, prompt_len: int, max_new: int) -> List[int]:
+    def reserve(self, prompt_len: int, max_new: int,
+                rid: Optional[str] = None) -> List[int]:
         """Worst-case KV-block reservation at SUBMIT time: raises
         ``KVBudgetExceeded`` (-> 429) when the arena cannot cover
         ``prompt + max_new`` positions — admission control instead of a
         mid-stream OOM.  The returned blocks are handed to ``admit``
-        (or ``release``d if the stream dies queued)."""
+        (or ``release``d if the stream dies queued).  With a request id
+        the reservation emits a ``kv_reserve`` span tagged with it."""
         self.validate(prompt_len, max_new)
-        return self.pool.alloc(self.pool.blocks_for(prompt_len + max_new))
+        n = self.pool.blocks_for(prompt_len + max_new)
+        if rid is not None:
+            with span("kv_reserve", cat="req", req=rid, blocks=n):
+                return self.pool.alloc(n)
+        return self.pool.alloc(n)
 
     def release(self, blocks: List[int]) -> None:
         self.pool.free(blocks)
@@ -261,6 +270,7 @@ class GenerationEngine:
         prompt: Sequence[int],
         max_new: int,
         blocks: Optional[List[int]] = None,
+        rid: Optional[str] = None,
     ) -> Tuple[int, int, float]:
         """Prefill one prompt into a free decode slot; returns ``(slot,
         first_token, first_logprob)`` — the first generated token comes
@@ -287,8 +297,9 @@ class GenerationEngine:
             padded[0, :n] = prompt
             idx = row[:bucket].copy()
             idx[n:] = self.pool.oob_row
+            sp_args = {"req": rid} if rid is not None else {}
             try:
-                with span("prefill", cat="gen", bucket=bucket):
+                with span("prefill", cat="gen", bucket=bucket, **sp_args):
                     tok, lp, ak, av = self._prefill(
                         self.params, padded, np.int32(n - 1), idx,
                         self.pool.k, self.pool.v,
@@ -304,6 +315,7 @@ class GenerationEngine:
             self._positions[slot] = n
             self._last[slot] = tok
             self._slot_blocks[slot] = list(blocks)
+            self._slot_rids[slot] = rid
             self._active[slot] = True
         return slot, tok, lp
 
@@ -315,7 +327,12 @@ class GenerationEngine:
             act = [i for i in range(self.max_streams) if self._active[i]]
             if not act:
                 return {}
-            with span("decode_step", cat="gen", active=len(act)):
+            # active-set membership: every traced stream sharing this
+            # iteration gets the step's duration attributed to it
+            rids = [r for r in (self._slot_rids[i] for i in act)
+                    if r is not None]
+            with span("decode_step", cat="gen", active=len(act),
+                      reqs=rids):
                 nxt, lps, ak, av = self._decode(
                     self.params,
                     self._last.copy(),
@@ -342,6 +359,7 @@ class GenerationEngine:
                 return
             blocks, self._slot_blocks[slot] = self._slot_blocks[slot], []
             self._active[slot] = False
+            self._slot_rids[slot] = None
             self._positions[slot] = 0
             self._last[slot] = 0
             self._index_map[slot, :] = 0

@@ -125,6 +125,15 @@ class KVBlockPool:
         with self._lock:
             return len(self._free)
 
+    def pressure(self) -> float:
+        """Fraction of the arena currently reserved (0.0 empty, 1.0
+        exhausted) — the serve plane's KV-pressure signal (the /healthz
+        profile block and the request profiler's kv-bound verdict read
+        it alongside the kv_reserve shed rate)."""
+        if self.num_blocks <= 0:
+            return 0.0
+        return self.used() / self.num_blocks
+
     def alloc(self, n: int) -> List[int]:
         """Reserve ``n`` blocks or raise ``KVBudgetExceeded`` — all or
         nothing, so a partially-admitted stream can never strand the

@@ -50,7 +50,9 @@ from typing import Optional
 
 import numpy as np
 
+from sparknet_tpu.obs import reqtrace as _reqtrace
 from sparknet_tpu.obs.exporter import JsonHTTPHandler
+from sparknet_tpu.obs.trace import span
 from sparknet_tpu.serve.batcher import (
     MicroBatcher,
     QueueFull,
@@ -58,9 +60,17 @@ from sparknet_tpu.serve.batcher import (
 )
 from sparknet_tpu.serve.engine import InferenceEngine
 from sparknet_tpu.serve.fleet import FleetUnservable, Router
+from sparknet_tpu.serve.kv_cache import KVBudgetExceeded
 from sparknet_tpu.utils.signals import SignalHandler, SolverAction
 
 _RETRY = [("Retry-After", "1")]
+
+
+def _shed_headers(cause: str):
+    """429/503 headers: Retry-After plus the machine-readable shed
+    cause (queue_full | kv_reserve | draining) — the header twin of the
+    ``cause=`` label on ``sparknet_gen_streams_shed_total``."""
+    return [("Retry-After", "1"), ("X-Shed-Cause", cause)]
 
 
 class _Handler(JsonHTTPHandler):
@@ -208,9 +218,15 @@ class _Handler(JsonHTTPHandler):
 
     # ------------------------------------------------------------------
     def _generate(self, ctx: "ServeServer", raw: bytes) -> None:
+        # the request id is minted HERE, at admission — every span the
+        # request touches downstream (queue, KV, prefill, decode steps,
+        # chunk writes) and every shed instant carries it
+        rid = _reqtrace.maybe_rid()
         if ctx.draining:
+            _reqtrace.note_shed("draining", rid=rid)
             self._send_json(
-                503, {"status": "draining"}, extra_headers=_RETRY
+                503, {"status": "draining"},
+                extra_headers=_shed_headers("draining"),
             )
             return
         try:
@@ -230,13 +246,18 @@ class _Handler(JsonHTTPHandler):
         # maps to a clean JSON status this way.  After the first token
         # the response is chunked NDJSON and errors become error events.
         try:
-            events = ctx.submit_stream(prompt, max_new)
+            events = ctx.submit_stream(prompt, max_new, rid=rid)
             first = next(events)
-        except QueueFull:
+        except QueueFull as e:
+            cause = (
+                "kv_reserve" if isinstance(e, KVBudgetExceeded)
+                else "queue_full"
+            )
             self._send_json(
                 429,
-                {"error": "queue or KV budget full, retry later"},
-                extra_headers=_RETRY,
+                {"error": "queue or KV budget full, retry later",
+                 "cause": cause},
+                extra_headers=_shed_headers(cause),
             )
             return
         except FleetUnservable as e:
@@ -257,7 +278,8 @@ class _Handler(JsonHTTPHandler):
         except RuntimeError as e:
             if ctx.draining:
                 self._send_json(
-                    503, {"status": "draining"}, extra_headers=_RETRY
+                    503, {"status": "draining"},
+                    extra_headers=_shed_headers("draining"),
                 )
             else:
                 self._send_json(500, {"error": f"generation failed: {e}"})
@@ -267,25 +289,30 @@ class _Handler(JsonHTTPHandler):
             return
         try:
             self._send_chunked_start(200, "application/x-ndjson")
-            self._send_chunk(
-                json.dumps(first).encode("utf-8") + b"\n"
-            )
+            self._write_event(first, rid)
             try:
                 for ev in events:  # stops itself after a terminal event
-                    self._send_chunk(
-                        json.dumps(ev).encode("utf-8") + b"\n"
-                    )
+                    self._write_event(ev, rid)
             except TimeoutError as e:
                 # headers are long gone — the failure IS an event
-                self._send_chunk(
-                    json.dumps(
-                        {"event": "error", "error": str(e)}
-                    ).encode("utf-8") + b"\n"
+                self._write_event(
+                    {"event": "error", "error": str(e)}, rid
                 )
             self._end_chunks()
         except (BrokenPipeError, ConnectionResetError, OSError):
             # client hung up mid-stream; the connection is unusable
             self.close_connection = True
+
+    def _write_event(self, ev: dict, rid) -> None:
+        """One NDJSON chunk; with a request id the socket write is a
+        ``stream_write`` span (a stalled client reads as write-bound,
+        not decode-bound)."""
+        data = json.dumps(ev).encode("utf-8") + b"\n"
+        if rid is None:
+            self._send_chunk(data)
+            return
+        with span("stream_write", cat="req", req=rid):
+            self._send_chunk(data)
 
 
 class ServeServer:
@@ -380,13 +407,13 @@ class ServeServer:
             return self.router.submit(x, timeout=timeout)
         return self.batcher.submit(x, timeout=timeout)
 
-    def submit_stream(self, prompt, max_new):
+    def submit_stream(self, prompt, max_new, rid=None):
         """Event iterator for one generation stream (gen mode only)."""
         if self.router is not None:
             return self.router.submit_stream(
-                prompt, max_new, timeout=self.request_timeout_s
+                prompt, max_new, timeout=self.request_timeout_s, rid=rid
             )
-        st = self.batcher.submit_stream(prompt, max_new)
+        st = self.batcher.submit_stream(prompt, max_new, rid=rid)
         return st.iter_events(timeout=self.request_timeout_s)
 
     @property
@@ -398,10 +425,15 @@ class ServeServer:
     def health_payload(self):
         """(code, payload) for /healthz.  Fleet mode 503s ONLY when the
         whole fleet is unservable; one draining replica stays 200."""
+        rp = _reqtrace.state()  # live request-profile block, if any
         if self.router is None:
+            payload = {"status": "ok"}
+            if rp is not None:
+                payload["request_profile"] = rp
             if self.draining:
-                return 503, {"status": "draining"}
-            return 200, {"status": "ok"}
+                payload["status"] = "draining"
+                return 503, payload
+            return 200, payload
         pool = self.router.pool
         states = pool.states()
         # live means SERVABLE: a nominally-live replica whose worker
@@ -416,6 +448,8 @@ class ServeServer:
                 "incumbent": pool.incumbent_id,
             },
         }
+        if rp is not None:
+            payload["request_profile"] = rp
         if self.delivery is not None:
             payload["delivery"] = self.delivery.status()
         if self.draining:

@@ -128,6 +128,7 @@ def test_unknown_mode_rejected():
     assert "genserve" in out.stderr  # ... and the generation-serving mode
     assert "stale" in out.stderr  # ... and the bounded-staleness mode
     assert "kernels" in out.stderr  # ... and the Pallas kernel-proof mode
+    assert "servetrace" in out.stderr  # ... and the request-anatomy mode
     # env-var route rejects identically
     out = subprocess.run(
         [sys.executable, os.path.join(_REPO, "bench.py")],
@@ -443,7 +444,7 @@ def test_perf_gate_passes_over_committed_artifacts():
     for fam in (
         "PIPELINE", "OBS", "HEALTH", "CHAOS", "SERVE", "PROFILE",
         "DATACACHE", "SANITIZE", "FLEET", "DELIVERY", "ELASTIC",
-        "RECOVER", "LM", "GENSERVE",
+        "RECOVER", "LM", "GENSERVE", "SERVEOBS",
     ):
         assert fam in gated, fam
 
@@ -1576,3 +1577,89 @@ def test_committed_kernels_artifact_schema():
     # honesty notes: interpret mode + modeled-bytes convention disclosed
     assert "modeled" in d["note"].lower()
     assert "interpret" in d["note"].lower()
+
+
+@pytest.mark.slow
+def test_servetrace_mode_smoke():
+    """bench.py --mode=servetrace end to end in a subprocess, trimmed
+    (the committed artifact pins the full sweep): the interleaved
+    overhead A/B runs, all five request stages fold through a real
+    HTTP server, the over-budget 429 carries its shed cause, the
+    seeded KV squeeze is attributed kv-bound, and the seeded slow
+    replica is named exactly."""
+    rec = _run_bench({
+        "BENCH_MODE": "servetrace", "BENCH_ST_JOBS": "8",
+        "BENCH_ST_TRIALS": "2", "BENCH_ST_SHORT": "8",
+        "BENCH_ST_LONG": "16", "BENCH_ST_STORM_CLIENTS": "10",
+        "BENCH_ST_STORM_STREAMS": "2", "BENCH_ST_FLEET_REQS": "8",
+    })
+    assert rec["metric"] == "servetrace_overhead_pct"
+    assert rec["traced_requests"] == 8 * 2
+    assert rec["post_warmup_recompiles"] == 0
+    assert rec["stages_covered"] == 5
+    assert rec["shed_cause_header"] == "kv_reserve"
+    assert rec["healthz_has_profile"] is True
+    assert rec["metrics_has_req_series"] is True
+    assert rec["kv_squeeze_attributed"] == 1
+    assert rec["kv_squeeze"]["verdict"] == "kv"
+    assert rec["slow_replica_correct"] == 1
+    assert rec["slow_replica_named"] == 1
+    assert rec["replica_skew"] >= 1.5
+
+
+_SERVEOBS_SCHEMA_KEYS = (
+    "metric", "value", "unit", "vs_baseline", "platform", "round",
+    "jobs", "trials", "overhead_pct", "noise_floor_pct",
+    "untraced_tokens_per_s", "traced_tokens_per_s", "traced_requests",
+    "post_warmup_recompiles", "ttft_p50_ms", "ttft_p95_ms",
+    "tpot_p50_ms", "stage_p95_ms", "stages_covered",
+    "shed_cause_header", "healthz_has_profile",
+    "metrics_has_req_series", "kv_squeeze", "kv_squeeze_attributed",
+    "slow_replica_seeded", "slow_replica_named", "slow_replica_correct",
+    "replica_skew", "note",
+)
+
+
+def test_committed_serveobs_artifact_schema():
+    """SERVEOBS_r22.json — the request-anatomy committed artifact
+    (ISSUE 19 done-bars): tracing overhead inside the <2% acceptance
+    with the box's untraced spread disclosed alongside, zero
+    post-warmup recompiles with the instrumentation live, every stage
+    covered through a real HTTP server, the 429 naming its cause, the
+    seeded KV squeeze attributed kv-bound, and the seeded slow replica
+    named exactly."""
+    with open(os.path.join(_REPO, "SERVEOBS_r22.json")) as f:
+        d = json.load(f)
+    for key in _SERVEOBS_SCHEMA_KEYS:
+        assert key in d, key
+    assert d["metric"] == "servetrace_overhead_pct"
+    assert d["unit"] == "percent"
+    assert d["value"] == d["overhead_pct"] < 2.0
+    assert d["round"] == 22
+    # the A/B: real throughput on both sides, overhead disclosed
+    # against the box's own drift (the noise-floor contract)
+    assert d["untraced_tokens_per_s"] > 0
+    assert d["traced_tokens_per_s"] > 0
+    assert d["noise_floor_pct"] >= 0
+    assert d["traced_requests"] == d["jobs"] * d["trials"] > 0
+    assert d["post_warmup_recompiles"] == 0
+    # end-to-end stage coverage through the HTTP server
+    assert d["stages_covered"] == 5
+    for stage in ("queue_wait", "kv_reserve", "prefill", "decode",
+                  "stream_write"):
+        assert d["stage_p95_ms"][stage] >= 0, stage
+    assert d["shed_cause_header"] == "kv_reserve"
+    assert d["healthz_has_profile"] is True
+    assert d["metrics_has_req_series"] is True
+    # seeded KV squeeze: sheds really fired and the verdict reads kv
+    assert d["kv_squeeze_attributed"] == 1
+    assert d["kv_squeeze"]["verdict"] == "kv"
+    assert d["kv_squeeze"]["shed_frac_kv"] > 0
+    assert d["kv_squeeze"]["shed"] > 0
+    # seeded slow replica: named exactly, skew guard tripped
+    assert d["slow_replica_seeded"] == d["slow_replica_named"] == 1
+    assert d["slow_replica_correct"] == 1
+    assert d["replica_skew"] >= 1.5
+    # honesty notes: interleaving + noise disclosure in prose
+    assert "interleaved" in d["note"].lower()
+    assert "noise" in d["note"].lower()

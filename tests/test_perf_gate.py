@@ -886,3 +886,87 @@ def test_kernels_cross_rules(tmp_path):
     assert any(
         r["family"] == "KERNELS x COMM" and not r["ok"] for r in rows
     )
+
+
+GOOD_SERVEOBS = {
+    "value": 0.9, "overhead_pct": 0.9, "noise_floor_pct": 3.0,
+    "traced_requests": 240, "post_warmup_recompiles": 0,
+    "stages_covered": 5, "shed_cause_header": "kv_reserve",
+    "healthz_has_profile": True, "metrics_has_req_series": True,
+    "kv_squeeze_attributed": 1, "slow_replica_correct": 1,
+    "replica_skew": 24.4, "tpot_p50_ms": 0.7,
+    "traced_tokens_per_s": 4500.0,
+}
+
+
+def test_serveobs_family_rules(tmp_path):
+    """The SERVEOBS family (ISSUE 19): tracing overhead inside the <2%
+    acceptance, zero recompiles with the instrumentation live, all
+    five stages covered through a real server, the 429 naming its shed
+    cause, the seeded KV squeeze attributed kv-bound, and the seeded
+    slow replica named exactly — any one regressing fails --check."""
+    g = _gate()
+    _write(tmp_path, "SERVEOBS_r22.json", GOOD_SERVEOBS)
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 0, [r for r in rows if not r["ok"]]
+    for bad_field, bad_value in (
+        ("overhead_pct", 4.5),             # tracing got expensive
+        ("traced_requests", 0),            # vacuous: nothing folded
+        ("post_warmup_recompiles", 1),     # instrumentation recompiled
+        ("stages_covered", 4),             # a stage stopped emitting
+        ("shed_cause_header", None),       # the 429 lost its cause
+        ("healthz_has_profile", False),    # /healthz block vanished
+        ("metrics_has_req_series", False),  # /metrics series vanished
+        ("kv_squeeze_attributed", 0),      # the squeeze misattributed
+        ("slow_replica_correct", 0),       # wrong/no replica named
+        ("replica_skew", 1.0),             # skew fold went flat
+    ):
+        _write(
+            tmp_path, "SERVEOBS_r23.json",
+            dict(GOOD_SERVEOBS, **{bad_field: bad_value}),
+        )
+        rc, rows = g.check(str(tmp_path))
+        assert rc == 1, bad_field
+        assert any(
+            bad_field in r["detail"] for r in rows if not r["ok"]
+        ), (bad_field, rows)
+
+
+def test_serveobs_cross_rules(tmp_path):
+    """SERVEOBS x GENSERVE: the profiler's decode-attributed TPOT must
+    agree with genserve's independently measured continuous throughput
+    (within the 4x occupancy/mix allowance), and the traced leg must
+    keep >=25% of the genserve rate — a broken fold or a tracing
+    slowdown fails even when each family passes alone."""
+    g = _gate()
+    genserve = dict(GOOD_GENSERVE, continuous_tokens_per_s=11000.0,
+                    decode_slots=4)
+    _write(tmp_path, "SERVEOBS_r22.json", GOOD_SERVEOBS)
+    _write(tmp_path, "GENSERVE_r19.json", genserve)
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 0, [r for r in rows if not r["ok"]]
+    crosses = [r for r in rows if r["family"] == "SERVEOBS x GENSERVE"]
+    assert len(crosses) == 2, crosses
+    # a TPOT fold wildly off the genserve-implied per-slot token time
+    # (4 slots / 11000 tok/s ~= 0.36 ms) fails the consistency rule
+    _write(
+        tmp_path, "SERVEOBS_r22.json",
+        dict(GOOD_SERVEOBS, tpot_p50_ms=5.0),
+    )
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 1
+    assert any(
+        r["family"] == "SERVEOBS x GENSERVE" and not r["ok"]
+        and "tpot" in r["detail"] for r in rows
+    ), rows
+    # a traced throughput collapse fails the retention rule
+    _write(
+        tmp_path, "SERVEOBS_r22.json",
+        dict(GOOD_SERVEOBS, traced_tokens_per_s=500.0),
+    )
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 1
+    assert any(
+        r["family"] == "SERVEOBS x GENSERVE" and not r["ok"]
+        and "traced_tokens_per_s" in r["detail"] for r in rows
+    ), rows

@@ -347,6 +347,33 @@ RULES: Dict[str, List[Rule]] = {
         Rule("bytes_identical", "is", True),
         Rule("minibatches_identical", "is", True),
     ],
+    "SERVEOBS": [
+        # the request-anatomy observability contract (bench.py
+        # --mode=servetrace, obs/reqtrace.py): tracing overhead on the
+        # interleaved A/B inside the OBS <2% acceptance (disclosed
+        # against the box's own untraced spread — the noise-floor
+        # contract), zero post-warmup recompiles with the
+        # instrumentation live, every request stage covered end to end
+        # through a real HTTP server (including the chunked-NDJSON
+        # stream_write), the 429 carrying its machine-readable shed
+        # cause, the /healthz request-profile block present, the
+        # seeded KV-pool squeeze ATTRIBUTED kv-bound (a squeezed arena
+        # sheds instead of queuing — time-shares alone cannot see
+        # it), and the seeded slow replica NAMED exactly with the
+        # two-condition skew guard tripped.  The TPOT-vs-throughput
+        # consistency check lives in _cross_rules vs GENSERVE.
+        Rule("value", "<", 2.0),
+        Rule("overhead_pct", "<", 2.0),
+        Rule("traced_requests", ">", 0),
+        Rule("post_warmup_recompiles", "==", 0),
+        Rule("stages_covered", ">=", 5),
+        Rule("shed_cause_header", "==", "kv_reserve"),
+        Rule("healthz_has_profile", "is", True),
+        Rule("metrics_has_req_series", "is", True),
+        Rule("kv_squeeze_attributed", "==", 1),
+        Rule("slow_replica_correct", "==", 1),
+        Rule("replica_skew", ">=", 1.5),
+    ],
 }
 
 
@@ -586,6 +613,38 @@ def _cross_rules(arts: Dict[str, dict]) -> List[Tuple[str, bool, str]]:
             bool(tol is not None and diff is not None
                  and 0 <= diff <= tol),
             "ring_flash_max_diff=%r <= LM sp_tolerance=%r" % (diff, tol),
+        ))
+    sobs = arts.get("SERVEOBS")
+    gen = arts.get("GENSERVE")
+    if sobs is not None and gen is not None:
+        # attribution consistency: the profiler's decode-attributed
+        # per-token time must agree with the genserve round's
+        # INDEPENDENTLY measured continuous throughput — 4x covers the
+        # workload-mix and partial-occupancy gap, not a broken fold
+        tpot = sobs.get("tpot_p50_ms")
+        tps = gen.get("continuous_tokens_per_s")
+        slots = gen.get("decode_slots")
+        implied = (
+            1e3 * slots / tps if tps and slots else None
+        )
+        out.append((
+            "SERVEOBS x GENSERVE",
+            bool(tpot is not None and implied is not None
+                 and 0 < tpot <= 4.0 * implied),
+            "profiled tpot_p50_ms=%r <= 4x genserve implied per-slot "
+            "token time %s ms"
+            % (tpot, "%.3f" % implied if implied else implied),
+        ))
+        # and tracing must not collapse serve throughput: the traced
+        # leg keeps >=25% of the genserve continuous rate (different
+        # token mix, same engine/box)
+        ttps = sobs.get("traced_tokens_per_s")
+        out.append((
+            "SERVEOBS x GENSERVE",
+            bool(ttps is not None and tps is not None
+                 and ttps >= 0.25 * tps),
+            "traced_tokens_per_s=%r >= 0.25 x genserve "
+            "continuous_tokens_per_s=%r" % (ttps, tps),
         ))
     comm = arts.get("COMM")
     if kern is not None and comm is not None:

@@ -1,0 +1,230 @@
+"""Fold a run log (or fleet bundle) into the per-request anatomy table.
+
+Input: a structured run-log ``.jsonl`` written by ``--trace_out`` /
+``obs.Tracer`` — or the fleet collector's merged ``/runlog`` bundle
+(``obs/fleet.py``), whose records carry a ``host`` tag — containing the
+request-anatomy spans (cat ``req``: ``request``/``queue_wait``/
+``kv_reserve``/``stream_write``; cat ``gen``: ``prefill``/
+``decode_step``) and ``shed`` instants.  Chrome trace JSON
+(``.trace.json``) works too; the ``cat`` rides each event natively.
+
+Output: the same numbers the live profiler serves — per-stage
+p50/p95/p99, TTFT/TPOT, shed causes, the bound-stage verdict,
+per-replica skew, and the slowest-N requests with stage breakdown and
+replica attribution.  There is ONE folding implementation: this tool
+replays every record through ``obs.reqtrace.RequestProfiler.on_span`` /
+``on_shed`` — the exact entry points ``trace.set_span_observer`` feeds
+live — and prints ``summary()`` / ``requests_table()``.  The offline
+report CANNOT drift from the live ``/healthz`` block, because they are
+the same code.
+
+Multi-host bundles: request ids are qualified as ``host/rid`` before
+folding (two hosts' ``req-000007`` never merge), the same convention
+``tools/trace_report.py`` applies to thread lanes.
+
+    python tools/request_report.py RUN.trace.jsonl
+    python tools/request_report.py bundle.runlog.jsonl --top 20
+    python tools/request_report.py RUN.trace.jsonl --json   # machine form
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from sparknet_tpu.obs.reqtrace import RequestProfiler  # noqa: E402
+
+# (name, cat, t0_s, t1_s, args) span tuples + (cause, args) sheds
+_REQ_SPANS = {"request", "queue_wait", "kv_reserve", "stream_write"}
+_GEN_SPANS = {"prefill", "decode_step"}
+
+
+def load_records(path: str) -> Tuple[List[tuple], List[dict]]:
+    """Parse a run-log ``.jsonl`` or Chrome ``.json`` into
+    ``(spans, sheds)``: spans as ``(name, cat, t0_s, t1_s, args)`` in
+    file order, sheds as their args dicts.  Host-tagged records get
+    their request ids qualified ``host/rid``."""
+    spans: List[tuple] = []
+    sheds: List[dict] = []
+
+    def _qualify(args: dict, host: Optional[str]) -> dict:
+        if not host or not args:
+            return args or {}
+        args = dict(args)
+        if args.get("req") is not None:
+            args["req"] = f"{host}/{args['req']}"
+        if args.get("reqs"):
+            args["reqs"] = [f"{host}/{r}" for r in args["reqs"]]
+        return args
+
+    def _take(name, cat, kind, t0_s, dur_s, args, host):
+        if kind == "span" and (name in _REQ_SPANS or name in _GEN_SPANS):
+            spans.append(
+                (name, cat, t0_s, t0_s + dur_s, _qualify(args, host))
+            )
+        elif kind == "instant" and name == "shed":
+            sheds.append(_qualify(args, host))
+
+    if path.endswith(".jsonl"):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                kind = rec.get("kind")
+                # instants log t_s, spans log ts_s (obs/trace.py)
+                t0 = float(rec.get("ts_s", rec.get("t_s", 0.0)))
+                _take(
+                    rec.get("name"), rec.get("cat"), kind, t0,
+                    float(rec.get("dur_ms", 0.0)) / 1e3,
+                    rec.get("args") or {}, rec.get("host"),
+                )
+        return spans, sheds
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"] if isinstance(doc, dict) else doc
+    for ev in events:
+        args = ev.get("args") or {}
+        host = ev.get("host") or args.get("host")
+        kind = {"X": "span", "i": "instant"}.get(ev.get("ph"))
+        _take(
+            ev.get("name"), ev.get("cat"), kind,
+            float(ev.get("ts", 0.0)) / 1e6,
+            float(ev.get("dur", 0.0)) / 1e6, args, host,
+        )
+    return spans, sheds
+
+
+def fold(spans: List[tuple], sheds: List[dict],
+         window: int = 65536) -> RequestProfiler:
+    """Replay the records through a fresh ``RequestProfiler`` — the
+    live folding code, not a reimplementation."""
+    prof = RequestProfiler(window=window, export_every=1 << 30)
+    for name, cat, t0, t1, args in spans:
+        prof.on_span(name, cat, t0, t1, "replay", args)
+    for args in sheds:
+        prof.on_shed(args.get("cause", "unknown"))
+    return prof
+
+
+def report(prof: RequestProfiler, top: int = 10) -> dict:
+    return {
+        "summary": prof.summary(),
+        "slowest": prof.requests_table(n=top),
+    }
+
+
+def _fmt_ms(v) -> str:
+    return "—" if v is None else f"{v:9.3f}"
+
+
+def render(rep: dict) -> str:
+    s = rep["summary"]
+    lines = [
+        f"requests folded: {s['requests_profiled']} "
+        f"(window {s['requests']})",
+        f"verdict: {s['verdict']}-bound   "
+        f"kv-shed fraction: {s['kv_shed_frac']:.4f}",
+    ]
+    if s["ttft_ms"]:
+        lines.append(
+            "TTFT ms   p50 {p50:.3f}   p95 {p95:.3f}   p99 {p99:.3f}"
+            .format(**s["ttft_ms"])
+        )
+    if s["tpot_ms"]:
+        lines.append(
+            "TPOT ms   p50 {p50:.3f}   p95 {p95:.3f}".format(**s["tpot_ms"])
+        )
+    lines.append("")
+    lines.append(
+        f"{'stage':>14} {'count':>7} {'p50 ms':>10} {'p95 ms':>10} "
+        f"{'p99 ms':>10} {'max ms':>10} {'share':>7}"
+    )
+    shares = s.get("stage_shares", {})
+    for name, st in s["stages"].items():
+        if not st["count"]:
+            continue
+        share = shares.get(name)
+        lines.append(
+            f"{name:>14} {st['count']:>7} {st['p50_ms']:>10.3f} "
+            f"{st['p95_ms']:>10.3f} {st['p99_ms']:>10.3f} "
+            f"{st['max_ms']:>10.3f} "
+            + (f"{share:>7.2%}" if share is not None else f"{'—':>7}")
+        )
+    if s["sheds"]:
+        lines.append("")
+        lines.append("sheds by cause: " + ", ".join(
+            f"{c}={n}" for c, n in sorted(s["sheds"].items())
+        ))
+    if s.get("replicas"):
+        lines.append("")
+        lines.append("per-replica:")
+        for idx, row in sorted(s["replicas"].items()):
+            tag = "  <- slow" if (
+                s.get("slow_replica") is not None
+                and str(s["slow_replica"]) == idx
+            ) else ""
+            lines.append(
+                f"  replica {idx}: {row['requests']} requests, "
+                f"mean {row['mean_ms']:.3f} ms{tag}"
+            )
+        if s.get("skew") is not None:
+            lines.append(f"  skew (max/median): {s['skew']:.3f}")
+    if rep["slowest"]:
+        lines.append("")
+        lines.append(f"slowest {len(rep['slowest'])} requests:")
+        lines.append(
+            f"{'rid':>20} {'total ms':>10} {'ttft ms':>10} "
+            f"{'tokens':>7} {'replica':>8} {'outcome':>8}  stages"
+        )
+        for r in rep["slowest"]:
+            stages = " ".join(
+                f"{k}={v:.2f}" for k, v in r["stages_ms"].items()
+            )
+            lines.append(
+                f"{str(r['rid']):>20} {r['total_ms']:>10.3f} "
+                f"{_fmt_ms(r['ttft_ms']):>10} "
+                f"{str(r['tokens']):>7} {str(r['replica']):>8} "
+                f"{str(r['outcome']):>8}  {stages}"
+            )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-request anatomy from a run log or fleet bundle"
+    )
+    ap.add_argument("path", help=".jsonl run log / bundle or .trace.json")
+    ap.add_argument("--top", type=int, default=10,
+                    help="slowest-N requests to list (default 10)")
+    ap.add_argument("--window", type=int, default=65536,
+                    help="profiler window (default covers the file)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable JSON instead of the table")
+    args = ap.parse_args(argv)
+
+    spans, sheds = load_records(args.path)
+    if not spans and not sheds:
+        print(
+            "no request-anatomy records found (need cat=req/gen spans "
+            "or shed instants — was tracing on?)", file=sys.stderr,
+        )
+        return 1
+    rep = report(fold(spans, sheds, window=args.window), top=args.top)
+    if args.json:
+        print(json.dumps(rep, indent=2, sort_keys=True))
+    else:
+        print(render(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
