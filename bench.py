@@ -270,7 +270,7 @@ _MODES = (
     "train", "hostfeed", "scaling", "serve", "chaos", "pipeline", "obs",
     "health", "profile", "datacache", "sanitize", "fleet", "delivery",
     "elastic", "recover", "lm", "genserve", "stale", "kernels",
-    "servetrace",
+    "servetrace", "slo",
 )
 _MODE = os.environ.get("BENCH_MODE", "train")
 for _i, _a in enumerate(sys.argv[1:], start=1):
@@ -4836,6 +4836,428 @@ def bench_servetrace():
     print(json.dumps(out))
 
 
+def bench_slo():
+    """Time-series + SLO plane proof (ISSUE 20 / round 23;
+    ``obs/tsdb.py`` + ``obs/slo.py``).
+
+    Legs (all against a SIMULATED clock — the evaluator and TSDB take
+    explicit timestamps, so 90 simulated minutes replay in seconds and
+    the detection-delay numbers are exact, not scheduler-noise):
+
+    1. **healthy control** — 3 simulated hosts emit the full canonical
+       serve+train series set (streams, sheds, TTFT/TPOT histograms,
+       per-phase latency, rounds, stragglers) under a diurnal arrival
+       curve for 90 sim-minutes.  Background sheds run at half the
+       availability budget and every 50th round is a straggler (a
+       fifth of that budget): the burn-rate evaluator must stay SILENT
+       — zero alert transitions — while the TSDB holds every series
+       under its byte budget with zero dropped series.
+    2. **seeded faults, detected** — same workload, fresh plane: a 6x
+       TTFT regression at T+3600s (600 s) and a 40% shed storm at
+       T+4500s (400 s).  Each objective's FIRST alert must land within
+       one short burn window (300 s) of its seeded fault, pages must
+       follow where the page rule's windows can fill, and nothing may
+       fire before the first seed.
+    3. **rollup agreement + signals** — on the control TSDB: a raw
+       step-1 query and the 10 s rollup over the same aligned span
+       must agree (counts exactly, min/max/mean to float noise), and
+       /signals-style outputs must match values recomputed from raw
+       query() points (admission pressure, per-host round rate,
+       error-budget min vs the /slo table).
+    4. **HTTP endpoints** — a real ``FleetCollector``: shipper-style
+       pushes land, then /query, /slo, /signals, /healthz and /fleet
+       (with ``last_push_age_s`` per host) all answer well-formed.
+    """
+    import math
+    import random
+    import urllib.error
+    import urllib.request
+
+    import jax
+
+    from sparknet_tpu.obs.metrics import MetricsRegistry
+    from sparknet_tpu.obs.slo import SLOEvaluator
+    from sparknet_tpu.obs.tsdb import TSDB
+
+    sim_s = int(os.environ.get("BENCH_SLO_SIM_S", "5400"))
+    push_every = 2
+    eval_every = float(os.environ.get("BENCH_SLO_EVAL_S", "60"))
+    n_hosts = 3
+    t0 = 1_700_000_000.0  # divisible by 10: aligns rollup comparisons
+    budget_bytes = 32 << 20
+    t_lat, lat_dur = 3600, 600    # 6x TTFT regression
+    t_shed, shed_dur = 4500, 400  # 40% shed storm
+    window_s = 300.0
+
+    class SimHost:
+        """One host's canonical serve+train families over a real
+        registry — snapshot() yields the exact sample names a shipper
+        would push."""
+
+        def __init__(self, idx: int, seed: int):
+            self.idx = idx
+            self.rng = random.Random(seed)
+            self.arrivals = 0
+            r = self.registry = MetricsRegistry()
+            self.streams = r.counter(
+                "sparknet_gen_streams_total", "sim admitted streams"
+            )
+            self.shed = r.counter(
+                "sparknet_gen_streams_shed_total", "sim sheds",
+                labels=("cause",),
+            )
+            self.tokens = r.counter(
+                "sparknet_gen_tokens_total", "sim tokens"
+            )
+            self.active = r.gauge(
+                "sparknet_gen_active_streams", "sim active streams"
+            )
+            self.queue = r.gauge(
+                "sparknet_feed_queue_depth", "sim queue depth"
+            )
+            self.ttft = r.histogram(
+                "sparknet_gen_ttft_seconds", "sim TTFT"
+            )
+            self.tpot = r.histogram(
+                "sparknet_gen_intertoken_seconds", "sim intertoken"
+            )
+            self.phase = r.histogram(
+                "sparknet_phase_latency_seconds", "sim phases",
+                labels=("phase",),
+            )
+            self.rounds = r.counter("sparknet_rounds_total", "sim rounds")
+            self.stragglers = r.counter(
+                "sparknet_straggler_rounds_total", "sim stragglers"
+            )
+            self.rounds_n = 0
+
+        def tick(self, rel: int, ttft_mult=1.0, storm_shed_frac=0.0):
+            rng = self.rng
+            # diurnal curve, phase-shifted per host; 2..8 arrivals/s
+            rate = 5.0 + 3.0 * math.sin(
+                2 * math.pi * rel / 3600.0 + self.idx
+            )
+            n = int(rate) + (1 if rng.random() < rate - int(rate) else 0)
+            for _ in range(n):
+                self.arrivals += 1
+                # background sheds are DETERMINISTIC (every 2000th
+                # arrival = half the 0.001 budget) so the control leg's
+                # silence is a property, not a lucky seed
+                if self.arrivals % 2000 == 0:
+                    self.shed.labels("kv_reserve").inc()
+                elif storm_shed_frac and rng.random() < storm_shed_frac:
+                    self.shed.labels("queue_full").inc()
+                else:
+                    self.streams.inc()
+                    # healthy TTFT tops out at 0.44 s (< the 0.5 s
+                    # objective); the seeded regression multiplies past it
+                    base = 0.12 + 0.12 * rng.random()
+                    if self.arrivals % 200 == 0:
+                        base += 0.2  # benign tail, still under budget
+                    self.ttft.observe(base * ttft_mult)
+                    self.tpot.observe(0.015 + 0.01 * rng.random())
+                    self.tokens.inc(32)
+            self.active.set(round(rate * 0.4, 3))
+            self.queue.set(round(max(0.0, rate - 4.0), 3))
+            if rel % 20 == (self.idx * 7) % 20:
+                self.rounds.inc()
+                self.rounds_n += 1
+                # every 50th round straggles: exactly 2% of a 10% budget
+                if self.rounds_n % 50 == 0:
+                    self.stragglers.inc()
+                for ph in ("assemble", "h2d", "execute", "average"):
+                    self.phase.labels(ph).observe(
+                        0.004 + 0.003 * rng.random()
+                    )
+
+    def replay(fault: bool):
+        tsdb = TSDB(budget_bytes=budget_bytes)
+        ev = SLOEvaluator(tsdb, eval_interval_s=eval_every)
+        hosts = [SimHost(i, seed=100 * (i + 1) + int(fault)) for i
+                 in range(n_hosts)]
+        samples = 0
+        for rel in range(sim_s):
+            mult = (6.0 if fault and t_lat <= rel < t_lat + lat_dur
+                    else 1.0)
+            storm = (0.4 if fault and t_shed <= rel < t_shed + shed_dur
+                     else 0.0)
+            for h in hosts:
+                h.tick(rel, ttft_mult=mult, storm_shed_frac=storm)
+            if rel % push_every == 0:
+                now = t0 + rel
+                for h in hosts:
+                    snap = h.registry.snapshot()
+                    tsdb.record_snapshot(
+                        "h%d" % h.idx, snap["counters"], snap["gauges"],
+                        now,
+                    )
+                ev.maybe_evaluate(now)
+        final = ev.evaluate(now=t0 + sim_s)
+        return tsdb, ev, final
+
+    # ---- leg 1: healthy control must stay silent --------------------
+    c_tsdb, c_ev, c_final = replay(fault=False)
+    control_alerts = list(c_ev.alerts)
+    control_status = {r["name"]: r["status"] for r in c_final["slos"]}
+    c_stats = c_tsdb.stats()
+    control_evals = sum(
+        1 for r in c_final["slos"] if r["status"] != "no_data"
+    )
+    assert not control_alerts, control_alerts
+    assert all(s == "ok" for s in control_status.values()), control_status
+    assert c_stats["resident_bytes"] < budget_bytes, c_stats
+    assert c_stats["dropped_series_total"] == 0, c_stats
+    print(
+        "slo: control leg: %d sim-s x %d hosts, %d series, %d samples, "
+        "%.1f MiB resident (budget %.0f MiB) -> 0 alerts, all ok"
+        % (
+            sim_s, n_hosts, c_stats["series"], c_stats["samples_total"],
+            c_stats["resident_bytes"] / (1 << 20),
+            budget_bytes / (1 << 20),
+        ),
+        file=sys.stderr,
+    )
+
+    # ---- leg 2: seeded faults must be detected inside one window ----
+    f_tsdb, f_ev, f_final = replay(fault=True)
+    alerts = list(f_ev.alerts)
+    assert alerts, "no alerts on the fault leg"
+    first_t = min(a["t"] for a in alerts)
+    assert first_t >= t0 + t_lat, alerts[0]  # nothing before the seed
+
+    def _first(slo_name, severity=None, after=0.0):
+        ts = [
+            a["t"] - t0 for a in alerts
+            if a["slo"] == slo_name and a["t"] - t0 >= after
+            and (severity is None or a["severity"] == severity)
+        ]
+        return min(ts) if ts else None
+
+    lat_alert_t = _first("serve-ttft-p99", after=t_lat)
+    lat_page_t = _first("serve-ttft-p99", severity="page", after=t_lat)
+    shed_alert_t = _first("serve-availability", after=t_shed)
+    shed_page_t = _first("serve-availability", severity="page",
+                         after=t_shed)
+    assert lat_alert_t is not None and shed_alert_t is not None, alerts
+    lat_delay = lat_alert_t - t_lat
+    shed_delay = shed_alert_t - t_shed
+    assert 0 <= lat_delay <= window_s, (lat_alert_t, alerts)
+    assert 0 <= shed_delay <= window_s, (shed_alert_t, alerts)
+    # the shed storm's burn saturates BOTH page windows inside the
+    # storm; the TTFT page waits for the 1 h window to accumulate
+    # ~14.4 x budget of bad events (several minutes of all-bad
+    # traffic) — that lag is the multi-window design working, not a
+    # miss, and the leading warn above is the ±1-window detection the
+    # gate holds us to
+    assert shed_page_t is not None and lat_page_t is not None, alerts
+    print(
+        "slo: fault leg: ttft regression @+%ds -> alert +%.0fs (page "
+        "+%.0fs); shed storm @+%ds -> alert +%.0fs (page +%.0fs); "
+        "first alert %.0fs after first seed"
+        % (
+            t_lat, lat_delay, lat_page_t - t_lat, t_shed, shed_delay,
+            shed_page_t - t_shed, first_t - t0 - t_lat,
+        ),
+        file=sys.stderr,
+    )
+
+    # ---- leg 3a: raw vs rollup agreement on the control TSDB --------
+    now = t0 + sim_s  # multiple of 10: raw and 10 s buckets align
+    max_relerr = 0.0
+
+    def _relerr(a, b):
+        scale = max(abs(a), abs(b), 1e-12)
+        return abs(a - b) / scale
+
+    for series, host in (
+        ("sparknet_gen_streams_total", "h0"),
+        ("sparknet_feed_queue_depth", "h1"),
+    ):
+        q1 = c_tsdb.query(series, host=host, range_s=240.0, step_s=1.0,
+                          now=now)
+        q10 = c_tsdb.query(series, host=host, range_s=240.0, step_s=10.0,
+                           now=now)
+        assert q1["points"] and q10["points"], (series, q1, q10)
+        groups = {}
+        for p in q1["points"]:
+            g = groups.setdefault(int(p["t"] // 10) * 10, {
+                "min": float("inf"), "max": float("-inf"),
+                "count": 0, "wsum": 0.0,
+            })
+            g["min"] = min(g["min"], p["min"])
+            g["max"] = max(g["max"], p["max"])
+            g["count"] += p["count"]
+            g["wsum"] += p["mean"] * p["count"]
+        for p in q10["points"]:
+            g = groups.get(int(p["t"]))
+            assert g is not None, (series, p)
+            for err in (
+                _relerr(g["min"], p["min"]),
+                _relerr(g["max"], p["max"]),
+                _relerr(g["count"], p["count"]),
+                _relerr(g["wsum"] / g["count"], p["mean"]),
+            ):
+                max_relerr = max(max_relerr, err)
+    downsample_agree = max_relerr < 1e-6
+    assert downsample_agree, max_relerr
+
+    # ---- leg 3b: /signals must match raw-series recomputation -------
+    sig = c_ev.signals(now=now)
+    signals_checked = 0
+
+    def _increase(series, host=None):
+        q = c_tsdb.query(series, host=host, range_s=window_s, step_s=1.0,
+                         now=now)
+        pts = q["points"]
+        return (pts[-1]["last"] - pts[0]["last"]) if len(pts) > 1 else 0.0
+
+    shed_inc = sum(
+        _increase(s) for s in c_tsdb.series_names(
+            "sparknet_gen_streams_shed_total{"
+        )
+    )
+    adm_inc = _increase("sparknet_gen_streams_total")
+    raw_pressure = shed_inc / max(1.0, adm_inc + shed_inc)
+    assert abs(raw_pressure - sig["admission_pressure"]) < 2e-3, (
+        raw_pressure, sig["admission_pressure"],
+    )
+    signals_checked += 1
+    for h in ("h0", "h1", "h2"):
+        raw_rate = _increase("sparknet_rounds_total", host=h) / window_s
+        got = sig["round_rate_per_s"][h]
+        assert abs(raw_rate - got) <= max(0.25 * raw_rate, 0.02), (
+            h, raw_rate, got,
+        )
+    signals_checked += 1
+    budget_min = min(r["budget_remaining"] for r in c_final["slos"])
+    assert abs(sig["error_budget_min"] - budget_min) < 1e-9, (
+        sig["error_budget_min"], budget_min,
+    )
+    signals_checked += 1
+    print(
+        "slo: rollup agreement max relerr %.2e; signals vs raw: "
+        "pressure %.5f~%.5f, %d round rates, budget min %.4f"
+        % (
+            max_relerr, raw_pressure, sig["admission_pressure"],
+            n_hosts, budget_min,
+        ),
+        file=sys.stderr,
+    )
+
+    # ---- leg 4: the collector's HTTP surface ------------------------
+    from sparknet_tpu.obs.fleet import FleetCollector
+
+    coll = FleetCollector(host="127.0.0.1", port=0).start()
+    try:
+        t_now = time.time()
+        for seq in range(10):
+            for hi in range(n_hosts):
+                coll.ingest({
+                    "host": "h%d" % hi, "boot_id": "b0", "seq": seq,
+                    "t_send": t_now - (10 - seq) * 2.0, "round": seq,
+                    "counters": {
+                        "sparknet_gen_streams_total": 10.0,
+                        "sparknet_rounds_total": 1.0,
+                    },
+                    "gauges": {"sparknet_gen_active_streams": 2.0 + hi},
+                }, t_recv=t_now - (10 - seq) * 2.0)
+        base = "http://%s:%d" % coll.address
+
+        def _get(path):
+            try:
+                with urllib.request.urlopen(base + path, timeout=10) as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        ok = True
+        st, q = _get(
+            "/query?series=sparknet_gen_streams_total&range=120&step=10"
+        )
+        ok &= st == 200 and q["points"] and q["tsdb"]["series"] > 0
+        st, s = _get("/slo")
+        ok &= st == 200 and {"slos", "policy", "alerts"} <= set(s)
+        st, g = _get("/signals")
+        ok &= st == 200 and "round_rate_per_s" in g
+        st, hz = _get("/healthz")
+        ok &= st == 200 and "slo" in hz and "status" in hz["slo"]
+        st, fl = _get("/fleet")
+        ok &= st == 200 and all(
+            "last_push_age_s" in row for row in fl["hosts"].values()
+        )
+        st, bad = _get("/query?series=no_such_series&range=60")
+        ok &= st == 404 and "error" in bad
+        endpoints_ok = bool(ok)
+    finally:
+        coll.close()
+    assert endpoints_ok
+
+    value = round(max(lat_delay, shed_delay) / window_s, 3)
+    out = {
+        "metric": "slo_detection_delay_windows",
+        "value": value,
+        "unit": "burn windows (300 s)",
+        "vs_baseline": value,  # fraction of the ±1-window budget used
+        "platform": jax.devices()[0].platform,
+        "round": 23,
+        "hosts": n_hosts,
+        "replay_sim_s": sim_s,
+        "push_interval_s": push_every,
+        "eval_interval_s": eval_every,
+        "series_tracked": c_stats["series"],
+        "samples_recorded": c_stats["samples_total"],
+        "ttft_threshold_ms": 500,
+        "availability_target": 0.999,
+        "page_policy": "burn>=14.4x over 5m AND 1h",
+        "warn_policy": "burn>=1x over 6h",
+        "latency_alert_fired": lat_alert_t is not None,
+        "latency_seeded_t_s": t_lat,
+        "latency_alert_t_s": round(lat_alert_t, 1),
+        "latency_detect_delay_s": round(lat_delay, 1),
+        "latency_page_delay_s": round(lat_page_t - t_lat, 1),
+        "shed_alert_fired": shed_alert_t is not None,
+        "shed_seeded_t_s": t_shed,
+        "shed_alert_t_s": round(shed_alert_t, 1),
+        "shed_detect_delay_s": round(shed_delay, 1),
+        "shed_page_delay_s": round(shed_page_t - t_shed, 1),
+        "control_false_alarms": len(control_alerts),
+        "control_evals": control_evals,
+        "tsdb_budget_bytes": budget_bytes,
+        "tsdb_resident_bytes": c_stats["resident_bytes"],
+        "tsdb_under_budget": c_stats["resident_bytes"] < budget_bytes,
+        "tsdb_dropped_series": c_stats["dropped_series_total"],
+        "downsample_max_relerr": max_relerr,
+        "downsample_agree": downsample_agree,
+        "signals_match": signals_checked == 3,
+        "signals_checked": signals_checked,
+        "round_rate_hosts": len(sig["round_rate_per_s"]),
+        "error_budget_min": round(budget_min, 6),
+        "endpoints_ok": endpoints_ok,
+        "note": "all legs replay a SIMULATED clock (the TSDB and "
+        "evaluator take explicit timestamps), so 90 sim-minutes of 3 "
+        "hosts x the full canonical serve+train series set run in "
+        "seconds and detection delays are exact.  Leg 1 holds the "
+        "control replay to ZERO alert transitions with background "
+        "sheds at half the availability budget and stragglers at a "
+        "fifth of theirs — deterministic schedules, not a lucky seed "
+        "— while the ring+rollup store stays under its byte budget "
+        "with zero dropped series.  Leg 2 seeds a 6x TTFT regression "
+        "and a 40%% shed storm: each objective's FIRST alert lands "
+        "within one 300 s burn window of its seed (the availability "
+        "page inside the storm; the TTFT page once the 1 h window "
+        "accumulates ~7 min of all-bad traffic — the leading 6 h warn "
+        "is the detection the value metric scores).  Leg 3 proves the "
+        "10 s rollup agrees "
+        "with raw step-1 queries over an aligned span (max relerr "
+        "%.1e) and that /signals values match recomputation from raw "
+        "query() points.  Leg 4 drives a real FleetCollector over "
+        "HTTP: /query, /slo, /signals, /healthz (slo block) and "
+        "/fleet (last_push_age_s) all answer well-formed."
+        % max_relerr,
+    }
+    print(json.dumps(out))
+
+
 def bench_recover():
     """Crash-consistency proof (``runtime/chaos.run_kill_sweep``): a
     REAL SIGKILL at every phase boundary of the journaled driver loop,
@@ -5852,6 +6274,9 @@ def main():
         return
     if _MODE == "servetrace":
         bench_servetrace()
+        return
+    if _MODE == "slo":
+        bench_slo()
         return
     # the remote-TPU tunnel occasionally drops a request mid-run; one
     # retry keeps the recorded benchmark from dying on a transient

@@ -143,6 +143,26 @@ CANONICAL_METRICS = {
     "sparknet_fleet_lost_events_total": ("host",),
     "sparknet_fleet_pushes_total": ("host",),
     "sparknet_fleet_resets_total": ("host",),
+    # time-series plane (obs/tsdb.py) — the embedded rollup store's
+    # self-accounting, exported wherever a TSDB is armed (--slo or the
+    # fleet collector)
+    "sparknet_tsdb_resident_bytes": (),
+    "sparknet_tsdb_series": (),
+    "sparknet_tsdb_samples_total": (),
+    "sparknet_tsdb_dropped_series_total": (),
+    # burn-rate SLO plane (obs/slo.py) — objective health + alert
+    # counters from the multi-window multi-burn-rate evaluator
+    "sparknet_slo_burn_rate": ("slo", "window"),
+    "sparknet_slo_error_budget_remaining": ("slo",),
+    "sparknet_slo_status": ("slo",),
+    "sparknet_slo_alerts_total": ("slo", "severity"),
+    # scaling signals (obs/slo.py signals()) — the /signals feed an
+    # autoscaler consumes (ROADMAP item 4)
+    "sparknet_signal_admission_pressure": (),
+    "sparknet_signal_queue_depth_slope": (),
+    "sparknet_signal_p99_trend": (),
+    "sparknet_signal_round_rate": ("host",),
+    "sparknet_signal_error_budget_min": (),
 }
 
 # span names by category.  "phase" spans additionally feed the

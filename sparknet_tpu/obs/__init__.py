@@ -447,6 +447,9 @@ _sentry = None
 # /healthz exports its view so an orchestrator can tell "slice 1 is
 # leaving" from "the job is wedged"
 _membership = None
+# the active burn-rate SLO evaluator (obs/slo.py, --slo) — /healthz
+# exports objective statuses + recent alert transitions
+_slo_evaluator = None
 
 
 def enable_training_metrics() -> TrainingMetrics:
@@ -474,11 +477,13 @@ def _reset_training_metrics_for_tests() -> None:
     for production code (instrumented sites cache nothing, so the swap
     is safe mid-process)."""
     global _training, _unhealthy_reason, _sentry, _membership
+    global _slo_evaluator
     with _lock:
         _training = None
         _unhealthy_reason = None
         _sentry = None
         _membership = None
+        _slo_evaluator = None
         set_phase_observer(None)
         set_ship(None)
     flight.uninstall()
@@ -519,6 +524,21 @@ def profile_state() -> Optional[dict]:
     """The active round profiler's exported state (straggler verdict,
     hidden fractions), or None — the /healthz "profile" block."""
     return profile.state()
+
+
+def set_slo_evaluator(evaluator) -> None:
+    """Register the run's SLO evaluator (None clears) — /healthz gains
+    an ``slo`` block with objective statuses and recent alerts."""
+    global _slo_evaluator
+    _slo_evaluator = evaluator
+
+
+def slo_state() -> Optional[dict]:
+    """The active SLO evaluator's compact state, or None."""
+    ev = _slo_evaluator
+    if ev is None:
+        return None
+    return ev.state()
 
 
 def fault(kind: str, **args) -> None:
@@ -598,6 +618,14 @@ def add_cli_args(parser) -> None:
         "compare this run against the committed baselines",
     )
     parser.add_argument(
+        "--slo", action="store_true",
+        help="retain metric history in the in-process TSDB "
+        "(obs/tsdb.py ring buffers, staged 1s/10s/60s rollups) and "
+        "evaluate burn-rate SLOs over it (obs/slo.py): the sidecar "
+        "gains /query, /slo and /signals plus an slo /healthz block "
+        "(implies --obs)",
+    )
+    parser.add_argument(
         "--ship_to", default=None, metavar="http://HOST:PORT",
         help="ship this process's metric deltas + run-log events to a "
         "fleet collector (obs/ship.py; dedicated thread, bounded "
@@ -648,7 +676,8 @@ class ObsRun:
                  profiler: Optional["RoundProfiler"] = None,
                  echo=None, profile_out: Optional[str] = None,
                  shipper: Optional["Shipper"] = None,
-                 collector: Optional["FleetCollector"] = None):
+                 collector: Optional["FleetCollector"] = None,
+                 sampler=None):
         self.exporter = exporter
         self.tracer = tracer
         self.trace_out = trace_out
@@ -658,6 +687,7 @@ class ObsRun:
         self.profile_out = profile_out
         self.shipper = shipper
         self.collector = collector
+        self.sampler = sampler
         self._echo = echo
         self._closed = False
 
@@ -689,6 +719,11 @@ class ObsRun:
                 except Exception:  # noqa: BLE001 — teardown must not die
                     pass
             profile.uninstall(self.profiler)
+        if self.sampler is not None:
+            # final sample + evaluator pass, then detach: a later run in
+            # this process must not inherit this run's alert state
+            self.sampler.stop()
+            set_slo_evaluator(None)
         if self.exporter is not None:
             self.exporter.close()
         if self.tracer is not None:
@@ -776,6 +811,7 @@ def start(
     ship_to: Optional[str] = None,
     fleet_collector: Optional[str] = None,
     host_id: Optional[str] = None,
+    slo: bool = False,
     echo=print,
 ) -> ObsRun:
     """Turn telemetry on for this run: ``metrics=True`` starts the
@@ -786,10 +822,14 @@ def start(
     collector in this process; ``ship_to`` (a collector URL) ships this
     process's metric deltas + run-log events there — with a collector
     but no ``ship_to`` the process ships to its own collector.
+    ``slo=True`` (implies metrics) arms the in-process TSDB sampler +
+    burn-rate SLO evaluator, and the sidecar additionally serves
+    /query, /slo and /signals.
     metrics/trace/profile/ship also enable the training metric series
     (spans feed the per-phase histogram; the shipper snapshots it).
     Returns an ``ObsRun`` to ``close()`` in the run's ``finally``."""
     profile_rounds = profile_rounds or bool(profile_out)
+    metrics = metrics or slo
     if not any((metrics, trace_out, flight_out, profile_rounds, ship_to,
                 fleet_collector)):
         return ObsRun()
@@ -822,10 +862,33 @@ def start(
     if not any((metrics, trace_out, profile_rounds, ship_to)):
         return ObsRun(recorder=recorder, collector=collector, echo=echo)
     tm = enable_training_metrics()
+    sampler = None
+    evaluator = None
+    tsdb = None
+    if slo:
+        from sparknet_tpu.obs.slo import SLOEvaluator, TsdbSampler
+        from sparknet_tpu.obs.tsdb import TSDB
+
+        tsdb = TSDB(registry=tm.registry)
+        evaluator = SLOEvaluator(
+            tsdb, registry=tm.registry, live_registry=tm.registry,
+            host=host_id,
+        )
+        set_slo_evaluator(evaluator)
+        sampler = TsdbSampler(
+            tsdb, tm.registry, evaluator=evaluator,
+            host=host_id or "local",
+        ).start()
+        if echo is not None:
+            echo(
+                "obs: SLO plane armed — TSDB sampler + burn-rate "
+                "evaluator (/query, /slo, /signals)"
+            )
     exporter = None
     if metrics:
         exporter = ObsExporter(
-            tm.registry, host=host, port=port, health_fn=health_reason
+            tm.registry, host=host, port=port, health_fn=health_reason,
+            tsdb=tsdb, slo=evaluator,
         ).start()
         if echo is not None:
             h, p = exporter.address
@@ -851,7 +914,7 @@ def start(
             )
     return ObsRun(exporter, tracer, trace_out, tm, recorder, profiler, echo,
                   profile_out=profile_out, shipper=shipper,
-                  collector=collector)
+                  collector=collector, sampler=sampler)
 
 
 def start_from_args(args, echo=print) -> ObsRun:
@@ -865,5 +928,6 @@ def start_from_args(args, echo=print) -> ObsRun:
         ship_to=getattr(args, "ship_to", None),
         fleet_collector=getattr(args, "fleet_collector", None),
         host_id=getattr(args, "host_id", None),
+        slo=getattr(args, "slo", False),
         echo=echo,
     )

@@ -20,6 +20,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
+from urllib.parse import parse_qs, urlparse
 
 from sparknet_tpu.obs.metrics import MetricsRegistry
 
@@ -90,6 +91,41 @@ class _ObsHandler(JsonHTTPHandler):
                 ex.registry.render().encode("utf-8"),
                 "text/plain; version=0.0.4",
             )
+        elif self.path.startswith("/query") and ex.tsdb is not None:
+            # single-host runs get the same rollup-history endpoint the
+            # fleet collector serves (``--slo`` arms the sampler)
+            q = parse_qs(urlparse(self.path).query)
+
+            def _one(key, default=None):
+                vals = q.get(key)
+                return vals[0] if vals else default
+
+            series = _one("series")
+            if not series:
+                self._send_json(400, {"error": "series= is required"})
+                return
+            try:
+                range_s = float(_one("range", "300"))
+                step = _one("step")
+                step_s = float(step) if step is not None else None
+            except ValueError as e:
+                self._send_json(400, {"error": f"bad range/step: {e}"})
+                return
+            res = ex.tsdb.query(
+                series, host=_one("host"), range_s=range_s, step_s=step_s
+            )
+            if res is None:
+                self._send_json(
+                    404, {"error": f"unknown series {series!r}"}
+                )
+                return
+            res["tsdb"] = ex.tsdb.stats()
+            self._send_json(200, res)
+        elif self.path == "/slo" and ex.slo is not None:
+            self._send_json(200, ex.slo.evaluate())
+        elif self.path == "/signals" and ex.slo is not None:
+            ex.slo.maybe_evaluate()
+            self._send_json(200, ex.slo.signals())
         elif self.path == "/healthz":
             reason = ex.health_fn() if ex.health_fn is not None else None
             # divergence-sentry state rides along so an orchestrator can
@@ -118,6 +154,13 @@ class _ObsHandler(JsonHTTPHandler):
             member = _obs.membership_state()
             if member is not None:
                 payload["membership"] = member
+            # burn-rate SLO block (--slo): objective statuses + recent
+            # alert transitions — a paging objective shows here without
+            # scraping /slo (the run itself stays 200: an SLO page is a
+            # capacity/objective verdict, not a wedged process)
+            slo = _obs.slo_state()
+            if slo is not None:
+                payload["slo"] = slo
             if reason:
                 payload.update({"status": "unhealthy", "reason": reason})
                 self._send_json(503, payload)
@@ -140,9 +183,16 @@ class ObsExporter:
         host: str = "127.0.0.1",
         port: int = 8380,
         health_fn: Optional[Callable[[], Optional[str]]] = None,
+        tsdb=None,
+        slo=None,
     ):
         self.registry = registry
         self.health_fn = health_fn
+        # retention plane (``--slo``): a TSDB + SLOEvaluator make this
+        # sidecar serve /query, /slo and /signals like the fleet
+        # collector does
+        self.tsdb = tsdb
+        self.slo = slo
         ex = self
 
         class BoundHandler(_ObsHandler):

@@ -33,12 +33,21 @@ What the merge provides:
   membership controller needs to answer "which host is slow, which
   host is gone".
 
+The collector also embeds the retention plane (``obs/tsdb.py``): every
+merged series is recorded per host into bounded ring-buffer history on
+each push, and the burn-rate SLO evaluator (``obs/slo.py``) runs over
+it on an interval — the fleet's memory, not just its snapshot.
+
 Endpoints: ``POST /push`` (shipper payloads), ``GET /fleet`` (the JSON
 fleet view), ``GET /metrics`` (Prometheus text: fleet families + every
 merged per-host series with a ``host`` label), ``GET /runlog`` (merged
 clock-aligned JSONL run log — ``tools/trace_report.py`` and
 ``tools/health_report.py`` fold it), ``GET /trace`` (merged Chrome
-trace, one Perfetto process lane per host), ``GET /healthz``.
+trace, one Perfetto process lane per host), ``GET
+/query?series=&host=&range=&step=`` (rollup history from the embedded
+TSDB), ``GET /slo`` (objective statuses + burn rates + recent alerts),
+``GET /signals`` (the autoscaler's decision inputs), ``GET /healthz``
+(with an ``slo`` block).
 
 ``pause()``/``resume()`` tear the listener down and rebind the same
 port — the seam the chaos ``collector_outage`` fault uses to prove the
@@ -53,9 +62,12 @@ import time
 from collections import deque
 from http.server import ThreadingHTTPServer
 from typing import Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
 
 from sparknet_tpu.obs.exporter import JsonHTTPHandler
 from sparknet_tpu.obs.metrics import MetricsRegistry, _escape_label, _fmt
+from sparknet_tpu.obs.slo import SLOEvaluator
+from sparknet_tpu.obs.tsdb import TSDB
 
 DEFAULT_FLEET_PORT = 8381
 
@@ -133,6 +145,9 @@ class FleetCollector:
         dead_after_s: float = DEFAULT_DEAD_AFTER_S,
         late_round_lag: int = DEFAULT_LATE_ROUND_LAG,
         events_per_host: int = 65536,
+        tsdb_budget_bytes: Optional[int] = None,
+        slo_eval_interval_s: float = 15.0,
+        slos=None,
     ):
         self._bind_host = host
         self.dead_after_s = float(dead_after_s)
@@ -194,6 +209,22 @@ class FleetCollector:
             "host process restarts detected (boot id changed on a "
             "delta push) — the merged totals keep growing across them",
             labels=("host",),
+        )
+        # the retention plane: every merged series lands in bounded
+        # ring-buffer history on each push, and the burn-rate SLO
+        # evaluator runs over it (rate-limited to its eval interval)
+        from sparknet_tpu.obs.tsdb import DEFAULT_BUDGET_BYTES
+
+        self.tsdb = TSDB(
+            budget_bytes=(
+                DEFAULT_BUDGET_BYTES if tsdb_budget_bytes is None
+                else tsdb_budget_bytes
+            ),
+            registry=self.registry,
+        )
+        self.slo = SLOEvaluator(
+            self.tsdb, slos=slos, registry=self.registry,
+            eval_interval_s=slo_eval_interval_s,
         )
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -344,6 +375,13 @@ class FleetCollector:
             prev_lost = self.m_lost.labels(host).value
             if lost > prev_lost:
                 self.m_lost.labels(host).inc(lost - prev_lost)
+            # copies for the retention plane: the TSDB records OUTSIDE
+            # the collector lock (its own lock is a leaf — no
+            # collector->tsdb hold-chain for /query readers to contend)
+            counters_now = dict(st.counters)
+            gauges_now = dict(st.gauges)
+        self.tsdb.record_snapshot(host, counters_now, gauges_now, t_recv)
+        self.slo.maybe_evaluate(t_recv)
         return {"ok": True, "host": host, "t_collector": t_recv}
 
     # ------------------------------------------------------------------
@@ -395,11 +433,19 @@ class FleetCollector:
                     rounds.append(st.round)
                 for name, v in st.counters.items():
                     fleet_counters[name] = fleet_counters.get(name, 0.0) + v
+                age_s = round(time.monotonic() - st.last_seen_mono, 3)
                 hosts[h] = {
                     "state": states[h],
                     "round": st.round,
-                    "age_s": round(
-                        time.monotonic() - st.last_seen_mono, 3
+                    "age_s": age_s,
+                    # explicit alias of the push-age clock vs the
+                    # dead_after_s deadline: a live host at
+                    # last_push_age_s ~ dead_after_s is seconds from
+                    # being condemned — visible BEFORE the verdict
+                    "last_push_age_s": age_s,
+                    "dead_in_s": (
+                        None if states[h] in ("dead", "finished")
+                        else round(max(0.0, self.dead_after_s - age_s), 3)
                     ),
                     "clock_offset_s": (
                         round(st.clock_offset_s, 6)
@@ -590,6 +636,9 @@ class _FleetHandler(JsonHTTPHandler):
         self._send_json(200, self.fleet.ingest(payload, t_recv))
 
     def do_GET(self):
+        if self.path.startswith("/query"):
+            self._handle_query()
+            return
         if self.path == "/fleet":
             self._send_json(200, self.fleet.fleet_view())
         elif self.path == "/metrics":
@@ -606,7 +655,52 @@ class _FleetHandler(JsonHTTPHandler):
             )
         elif self.path == "/trace":
             self._send_json(200, self.fleet.merged_trace())
+        elif self.path == "/slo":
+            self._send_json(200, self.fleet.slo.evaluate())
+        elif self.path == "/signals":
+            self.fleet.slo.maybe_evaluate()
+            self._send_json(200, self.fleet.slo.signals())
         elif self.path == "/healthz":
-            self._send_json(200, {"status": "ok"})
+            self._send_json(
+                200, {"status": "ok", "slo": self.fleet.slo.state()}
+            )
         else:
             self._send_json(404, {"error": f"no route {self.path}"})
+
+    def _handle_query(self):
+        """``GET /query?series=&host=&range=&step=`` over the embedded
+        TSDB (``range``/``step`` in seconds; ``host`` omitted = the
+        cross-host aggregate)."""
+        q = parse_qs(urlparse(self.path).query)
+
+        def _one(key, default=None):
+            vals = q.get(key)
+            return vals[0] if vals else default
+
+        series = _one("series")
+        if not series:
+            self._send_json(400, {
+                "error": "series= is required",
+                "series_available": len(self.fleet.tsdb.series_names()),
+            })
+            return
+        try:
+            range_s = float(_one("range", "300"))
+            step = _one("step")
+            step_s = float(step) if step is not None else None
+        except ValueError as e:
+            self._send_json(400, {"error": f"bad range/step: {e}"})
+            return
+        res = self.fleet.tsdb.query(
+            series, host=_one("host"), range_s=range_s, step_s=step_s
+        )
+        if res is None:
+            self._send_json(404, {
+                "error": f"unknown series {series!r}",
+                "series_available": len(self.fleet.tsdb.series_names()),
+                "hint": "names are full inline-labeled sample names "
+                "as /metrics exports them",
+            })
+            return
+        res["tsdb"] = self.fleet.tsdb.stats()
+        self._send_json(200, res)

@@ -23,6 +23,8 @@ not N ad-hoc instruments.
 from __future__ import annotations
 
 import threading
+import time
+from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
 # default latency buckets (seconds): 1 ms .. 30 s, roughly log-spaced
@@ -114,6 +116,13 @@ class Histogram:
     quantiles are over the recent window, which is what a serving
     dashboard wants (steady-state p99, not cold-start-polluted
     all-time p99).
+
+    ``window_quantile`` narrows further to a TIME window: observations
+    also enter a timestamped ring (same ``reservoir`` bound), and the
+    quantile is taken over only the last ``window_s`` seconds — a long
+    run's p99 stops diluting a fresh regression (the count-bounded
+    reservoir of a month-old server still remembers last week).  The
+    SLO latency evaluator (``obs/slo.py``) reads this view.
     """
 
     TYPE = "histogram"
@@ -135,6 +144,9 @@ class Histogram:
         self._ring: List[float] = []
         self._ring_cap = int(reservoir)
         self._ring_pos = 0
+        # (t_mono, v) pairs for the sliding TIME window; maxlen shares
+        # the reservoir bound so memory stays fixed either way
+        self._timed: deque = deque(maxlen=self._ring_cap)
         # sorted view of the ring, built lazily on the first quantile
         # read and kept until the next observation — a scrape reading
         # p50/p95/p99 sorts ONCE, not once per quantile
@@ -157,6 +169,7 @@ class Histogram:
             else:
                 self._ring[self._ring_pos] = v
                 self._ring_pos = (self._ring_pos + 1) % self._ring_cap
+            self._timed.append((time.monotonic(), v))
             self._sorted = None  # invalidate the cached sorted view
 
     @property
@@ -187,6 +200,36 @@ class Histogram:
             return 0.0
         idx = min(len(window) - 1, max(0, int(q * len(window))))
         return window[idx]
+
+    def window_quantile(
+        self, q: float, window_s: float = 60.0,
+        now: Optional[float] = None,
+    ) -> float:
+        """Nearest-rank quantile over only the observations of the
+        last ``window_s`` seconds (0.0 when none) — the sliding-window
+        view the SLO latency evaluator reads.  ``now`` overrides the
+        clock for tests; observations older than the window are
+        dropped from the timed ring on the way."""
+        now = time.monotonic() if now is None else now
+        cutoff = now - float(window_s)
+        with self._lock:
+            while self._timed and self._timed[0][0] < cutoff:
+                self._timed.popleft()
+            vals = sorted(v for _t, v in self._timed)
+        if not vals:
+            return 0.0
+        idx = min(len(vals) - 1, max(0, int(q * len(vals))))
+        return vals[idx]
+
+    def window_count(self, window_s: float = 60.0,
+                     now: Optional[float] = None) -> int:
+        """Observations inside the sliding time window."""
+        now = time.monotonic() if now is None else now
+        cutoff = now - float(window_s)
+        with self._lock:
+            while self._timed and self._timed[0][0] < cutoff:
+                self._timed.popleft()
+            return len(self._timed)
 
     def samples(self) -> List[Tuple[str, float]]:
         with self._lock:

@@ -318,8 +318,13 @@ def _spawn_local_procs(args, app_argv, collector) -> int:
         for h, st in sorted(view["hosts"].items()):
             if st["state"] != "live":
                 print(
-                    "launch:   %s is %s (round %s, last seen %.1fs ago)"
-                    % (h, st["state"], st["round"], st["age_s"])
+                    "launch:   %s is %s (round %s, last push %.1fs ago)"
+                    % (h, st["state"], st["round"], st["last_push_age_s"])
+                )
+            else:
+                print(
+                    "launch:   %s is live (round %s, last push %.1fs ago)"
+                    % (h, st["round"], st["last_push_age_s"])
                 )
     return rc
 

@@ -129,6 +129,7 @@ def test_unknown_mode_rejected():
     assert "stale" in out.stderr  # ... and the bounded-staleness mode
     assert "kernels" in out.stderr  # ... and the Pallas kernel-proof mode
     assert "servetrace" in out.stderr  # ... and the request-anatomy mode
+    assert "slo" in out.stderr  # ... and the time-series/SLO mode
     # env-var route rejects identically
     out = subprocess.run(
         [sys.executable, os.path.join(_REPO, "bench.py")],
@@ -444,7 +445,7 @@ def test_perf_gate_passes_over_committed_artifacts():
     for fam in (
         "PIPELINE", "OBS", "HEALTH", "CHAOS", "SERVE", "PROFILE",
         "DATACACHE", "SANITIZE", "FLEET", "DELIVERY", "ELASTIC",
-        "RECOVER", "LM", "GENSERVE", "SERVEOBS",
+        "RECOVER", "LM", "GENSERVE", "SERVEOBS", "SLO",
     ):
         assert fam in gated, fam
 
@@ -1663,3 +1664,88 @@ def test_committed_serveobs_artifact_schema():
     # honesty notes: interleaving + noise disclosure in prose
     assert "interleaved" in d["note"].lower()
     assert "noise" in d["note"].lower()
+
+
+@pytest.mark.slow
+def test_slo_mode_smoke():
+    """bench.py --mode=slo end to end in a subprocess (simulated clock:
+    the full 90 sim-minutes replay in seconds on CPU): both seeded
+    faults detected inside one burn window, the control silent, the
+    store under budget, rollups exact, signals faithful, endpoints up."""
+    rec = _run_bench({"BENCH_MODE": "slo"})
+    assert rec["metric"] == "slo_detection_delay_windows"
+    assert 0 < rec["value"] < 1.0
+    assert rec["latency_alert_fired"] is True
+    assert rec["shed_alert_fired"] is True
+    assert rec["latency_detect_delay_s"] < 300
+    assert rec["shed_detect_delay_s"] < 300
+    assert rec["control_false_alarms"] == 0 and rec["control_evals"] > 0
+    assert rec["tsdb_under_budget"] is True
+    assert rec["tsdb_dropped_series"] == 0
+    assert rec["downsample_agree"] is True
+    assert rec["signals_match"] is True
+    assert rec["endpoints_ok"] is True
+    assert rec["round_rate_hosts"] == rec["hosts"] == 3
+
+
+_SLO_SCHEMA_KEYS = (
+    "metric", "value", "unit", "vs_baseline", "platform", "round",
+    "hosts", "replay_sim_s", "push_interval_s", "eval_interval_s",
+    "series_tracked", "samples_recorded", "ttft_threshold_ms",
+    "availability_target", "page_policy", "warn_policy",
+    "latency_alert_fired", "latency_seeded_t_s", "latency_alert_t_s",
+    "latency_detect_delay_s", "latency_page_delay_s",
+    "shed_alert_fired", "shed_seeded_t_s", "shed_alert_t_s",
+    "shed_detect_delay_s", "shed_page_delay_s",
+    "control_false_alarms", "control_evals", "tsdb_budget_bytes",
+    "tsdb_resident_bytes", "tsdb_under_budget", "tsdb_dropped_series",
+    "downsample_max_relerr", "downsample_agree", "signals_match",
+    "signals_checked", "round_rate_hosts", "error_budget_min",
+    "endpoints_ok", "note",
+)
+
+
+def test_committed_slo_artifact_schema():
+    """SLO_r23.json — the time-series/SLO committed artifact (ISSUE 20
+    done-bars): each seeded fault's first alert within one 300 s burn
+    window, zero control false alarms across real evaluations, the
+    3-host full-series replay resident under the byte budget with no
+    dropped series, exact rollup agreement, faithful /signals, and the
+    whole HTTP surface answering."""
+    with open(os.path.join(_REPO, "SLO_r23.json")) as f:
+        d = json.load(f)
+    for key in _SLO_SCHEMA_KEYS:
+        assert key in d, key
+    assert d["metric"] == "slo_detection_delay_windows"
+    assert d["unit"] == "burn windows (300 s)"
+    assert d["round"] == 23
+    # detection: both faults alerted, the headline is the worst delay
+    # in burn windows and both sit inside one window
+    assert d["latency_alert_fired"] is True
+    assert d["shed_alert_fired"] is True
+    assert d["latency_alert_t_s"] >= d["latency_seeded_t_s"]
+    assert d["shed_alert_t_s"] >= d["shed_seeded_t_s"]
+    assert 0 < d["latency_detect_delay_s"] < 300
+    assert 0 < d["shed_detect_delay_s"] < 300
+    assert d["value"] == max(
+        d["latency_detect_delay_s"], d["shed_detect_delay_s"]
+    ) / 300.0 < 1.0
+    # pages follow the first alerts (the warn leads, the page confirms)
+    assert d["latency_page_delay_s"] >= d["latency_detect_delay_s"]
+    assert d["shed_page_delay_s"] >= d["shed_detect_delay_s"]
+    # control silence was proven over real evaluations
+    assert d["control_false_alarms"] == 0 and d["control_evals"] > 0
+    # bounded retention: 3 hosts x full canonical series set resident
+    assert d["hosts"] == 3 and d["series_tracked"] > 100
+    assert d["samples_recorded"] > 100_000
+    assert d["tsdb_resident_bytes"] < d["tsdb_budget_bytes"]
+    assert d["tsdb_under_budget"] is True and d["tsdb_dropped_series"] == 0
+    # exactness and faithfulness
+    assert d["downsample_agree"] is True
+    assert d["downsample_max_relerr"] <= 1e-6
+    assert d["signals_match"] is True and d["signals_checked"] >= 3
+    assert d["round_rate_hosts"] == d["hosts"]
+    assert 0 <= d["error_budget_min"] <= 1
+    assert d["endpoints_ok"] is True
+    # honesty note: simulated clock disclosed
+    assert "simulated" in d["note"].lower()

@@ -970,3 +970,99 @@ def test_serveobs_cross_rules(tmp_path):
         r["family"] == "SERVEOBS x GENSERVE" and not r["ok"]
         and "traced_tokens_per_s" in r["detail"] for r in rows
     ), rows
+
+
+GOOD_SLO = {
+    "value": 0.2, "latency_alert_fired": True, "shed_alert_fired": True,
+    "latency_detect_delay_s": 60.0, "shed_detect_delay_s": 60.0,
+    "control_false_alarms": 0, "control_evals": 5,
+    "tsdb_under_budget": True, "tsdb_dropped_series": 0,
+    "downsample_agree": True, "signals_match": True,
+    "endpoints_ok": True,
+    "ttft_threshold_ms": 500, "hosts": 3, "round_rate_hosts": 3,
+}
+
+
+def test_slo_family_rules(tmp_path):
+    """The SLO family (ISSUE 20): both seeded faults detected within
+    one burn window, the healthy control silent across real
+    evaluations, the store under budget with zero dropped series,
+    rollups agreeing with raw, /signals matching recomputation, and
+    the HTTP surface answering — any one regressing fails --check."""
+    g = _gate()
+    _write(tmp_path, "SLO_r23.json", GOOD_SLO)
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 0, [r for r in rows if not r["ok"]]
+    for bad_field, bad_value in (
+        ("value", 1.5),                    # detection slower than a window
+        ("latency_alert_fired", False),    # TTFT fault missed entirely
+        ("shed_alert_fired", False),       # shed storm missed entirely
+        ("latency_detect_delay_s", 600.0),  # detection crawled
+        ("shed_detect_delay_s", 301.0),
+        ("control_false_alarms", 2),       # healthy replay paged someone
+        ("control_evals", 0),              # control silence was vacuous
+        ("tsdb_under_budget", False),      # retention blew its budget
+        ("tsdb_dropped_series", 4),        # series refused at budget
+        ("downsample_agree", False),       # rollups diverged from raw
+        ("signals_match", False),          # /signals unfaithful to /query
+        ("endpoints_ok", False),           # HTTP surface broke
+    ):
+        _write(
+            tmp_path, "SLO_r24.json",
+            dict(GOOD_SLO, **{bad_field: bad_value}),
+        )
+        rc, rows = g.check(str(tmp_path))
+        assert rc == 1, bad_field
+        assert any(
+            bad_field in r["detail"] for r in rows if not r["ok"]
+        ), (bad_field, rows)
+
+
+def test_slo_cross_rules(tmp_path):
+    """SLO x SERVEOBS: the TTFT objective must be achievable on this
+    box (threshold >= serveobs' measured p95) or the control-leg
+    silence is vacuous.  SLO x FLEET: /signals is only as trustworthy
+    as the fleet plane under it — proven dead-host detection, bounded
+    clock offset, and a round-rate entry for every simulated host."""
+    g = _gate()
+    serveobs = dict(GOOD_SERVEOBS, ttft_p95_ms=420.5)
+    fleet = dict(GOOD_FLEET, dead_detected=True)
+    _write(tmp_path, "SLO_r23.json", GOOD_SLO)
+    _write(tmp_path, "SERVEOBS_r22.json", serveobs)
+    _write(tmp_path, "FLEET_r14.json", fleet)
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 0, [r for r in rows if not r["ok"]]
+    assert any(r["family"] == "SLO x SERVEOBS" for r in rows)
+    assert any(r["family"] == "SLO x FLEET" for r in rows)
+    # an objective the hardware cannot meet: threshold under the
+    # independently measured p95 pages forever -> cross rule fails
+    _write(
+        tmp_path, "SLO_r23.json", dict(GOOD_SLO, ttft_threshold_ms=300)
+    )
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 1
+    assert any(
+        r["family"] == "SLO x SERVEOBS" and not r["ok"]
+        and "ttft_threshold_ms" in r["detail"] for r in rows
+    ), rows
+    # a host missing from /signals round rates fails the FLEET cross
+    _write(
+        tmp_path, "SLO_r23.json", dict(GOOD_SLO, round_rate_hosts=2)
+    )
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 1
+    assert any(
+        r["family"] == "SLO x FLEET" and not r["ok"] for r in rows
+    ), rows
+    # an unproven fleet plane (no dead-host detection) likewise
+    _write(tmp_path, "SLO_r23.json", GOOD_SLO)
+    _write(
+        tmp_path, "FLEET_r14.json",
+        dict(GOOD_FLEET, dead_detected=False),
+    )
+    rc, rows = g.check(str(tmp_path))
+    assert rc == 1
+    assert any(
+        r["family"] == "SLO x FLEET" and not r["ok"]
+        and "dead_detected" in r["detail"] for r in rows
+    ), rows

@@ -1,0 +1,731 @@
+"""SLO plane (``obs/slo.py`` + wiring): indicator math per objective
+kind, the multi-window multi-burn-rate policy fold, alert transitions
+(trace instants, counters, flight dump), the /healthz block, the
+scaling-signal API, the collector/exporter HTTP surface, the sampler
+loop, the sliding-window histogram view, and exporter thread-safety
+under concurrent scrapes."""
+
+import json
+import math
+import os
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from sparknet_tpu import obs
+from sparknet_tpu.obs import flight as obs_flight
+from sparknet_tpu.obs.exporter import ObsExporter
+from sparknet_tpu.obs.fleet import FleetCollector
+from sparknet_tpu.obs.metrics import MetricsRegistry
+from sparknet_tpu.obs.slo import (
+    DEFAULT_POLICY,
+    SLO,
+    SLOEvaluator,
+    TsdbSampler,
+    default_slos,
+    window_label,
+)
+from sparknet_tpu.obs.tsdb import TSDB
+from sparknet_tpu.obs.trace import Tracer
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# divisible by every stage step, so window edges align with buckets
+T0 = 1_700_000_000.0
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state():
+    """SLO tests flip process-wide obs globals (tracer, training
+    metrics, the /healthz slo block) — start and end clean."""
+    obs.uninstall_tracer()
+    obs._reset_training_metrics_for_tests()
+    obs.set_slo_evaluator(None)
+    yield
+    t = obs.uninstall_tracer()
+    if t is not None:
+        t.close()
+    obs._reset_training_metrics_for_tests()
+    obs.set_slo_evaluator(None)
+
+
+class _ServeFeed:
+    """Cumulative serve counters pushed into a TSDB at a fixed cadence
+    — the shape ``record_snapshot`` sees from a real registry."""
+
+    def __init__(self, tsdb, host="h0"):
+        self.tsdb = tsdb
+        self.host = host
+        self.streams = 0.0
+        self.shed = 0.0
+
+    def run(self, t_start, dur_s, rate=10.0, shed_rate=0.0, cadence=10.0):
+        t = t_start
+        end = t_start + dur_s
+        while t < end - 1e-9:
+            t += cadence
+            self.streams += rate * cadence
+            self.shed += shed_rate * cadence
+            self.tsdb.record_snapshot(
+                self.host,
+                {
+                    "sparknet_gen_streams_total": self.streams,
+                    'sparknet_gen_streams_shed_total{cause="queue_full"}':
+                        self.shed,
+                },
+                {},
+                t,
+            )
+        return t
+
+
+def _avail_slo():
+    return SLO.availability(
+        "avail", 0.999,
+        bad="sparknet_gen_streams_shed_total{", bad_is_prefix=True,
+        total="sparknet_gen_streams_total", bad_outside_total=True,
+    )
+
+
+# ---------------------------------------------------------------------------
+# indicator math
+
+
+def test_availability_indicator_counts_sheds_outside_total():
+    tsdb = TSDB()
+    feed = _ServeFeed(tsdb)
+    t = feed.run(T0, 600, rate=9.0, shed_rate=1.0)
+    bad, total = _avail_slo().indicator(tsdb, 300.0, t)
+    # 10 s cadence: 29 measured intervals in the window (the raw ring
+    # retains 299 s back and the first retained push is the baseline)
+    assert math.isclose(bad, 290.0)
+    assert math.isclose(total, 2610.0 + 290.0)  # sheds never reached total
+    assert math.isclose(bad / total, 0.1)
+
+
+def test_availability_indicator_none_before_any_traffic():
+    assert _avail_slo().indicator(TSDB(), 300.0, T0) is None
+
+
+def _feed_ttft(tsdb, host="h0"):
+    """36 pushes 10 s apart: 18 healthy (8 obs <=0.25, 2 in (0.25,0.5]),
+    then 18 degraded (10 obs past every finite bucket)."""
+    b25 = b5 = inf = cnt = 0.0
+    sm = 0.0
+    for i in range(36):
+        if i < 18:
+            b25 += 8.0
+            b5 += 10.0
+            sm += 10.0 * 0.2
+        else:
+            sm += 10.0 * 1.0
+        inf += 10.0
+        cnt += 10.0
+        tsdb.record_snapshot(
+            host,
+            {
+                'sparknet_gen_ttft_seconds_bucket{le="0.25"}': b25,
+                'sparknet_gen_ttft_seconds_bucket{le="0.5"}': b5,
+                'sparknet_gen_ttft_seconds_bucket{le="+Inf"}': inf,
+                "sparknet_gen_ttft_seconds_sum": sm,
+                "sparknet_gen_ttft_seconds_count": cnt,
+            },
+            {},
+            T0 + 10.0 * i,
+        )
+
+
+def test_latency_indicator_reads_threshold_bucket():
+    tsdb = TSDB()
+    _feed_ttft(tsdb)
+    now = T0 + 350.0
+    slo = SLO.latency("ttft", 0.99, hist="sparknet_gen_ttft_seconds",
+                      threshold_s=0.5)
+    # a 600 s window covers every push (the first is the baseline):
+    # total moved 350, the le=0.5 bucket moved 170 -> 180 breached
+    bad, total = slo.indicator(tsdb, 600.0, now)
+    assert math.isclose(total, 350.0)
+    assert math.isclose(bad, 180.0)
+    # an off-boundary threshold snaps UP to the next bucket boundary
+    snapped = SLO.latency("ttft", 0.99, hist="sparknet_gen_ttft_seconds",
+                          threshold_s=0.4)
+    assert snapped.indicator(tsdb, 600.0, now) == (bad, total)
+    # a tighter threshold reads the tighter bucket (moved 136)
+    tight = SLO.latency("ttft", 0.99, hist="sparknet_gen_ttft_seconds",
+                        threshold_s=0.25)
+    bad2, total2 = tight.indicator(tsdb, 600.0, now)
+    assert math.isclose(total2, 350.0)
+    assert math.isclose(bad2, 350.0 - 136.0)
+
+
+def test_latency_indicator_mean_fallback_without_buckets():
+    tsdb = TSDB()
+    c = s = 0.0
+    for i in range(36):
+        c += 10.0
+        s += 9.0  # mean 0.9 s per observation
+        tsdb.record_snapshot(
+            "h0", {"x_seconds_count": c, "x_seconds_sum": s}, {},
+            T0 + 10.0 * i,
+        )
+    slo = SLO.latency("x", 0.99, hist="x_seconds", threshold_s=0.5)
+    bad, total = slo.indicator(tsdb, 300.0, T0 + 350.0)
+    assert bad == total > 0  # whole window judged bad by its mean
+
+
+def test_round_time_single_round_is_unjudgeable():
+    """Cold start: one round in the window has no measured cadence —
+    the indicator must answer no-data, not a spurious alert."""
+    tsdb = TSDB()
+    tsdb.record("sparknet_rounds_total", "h0", 1.0, T0, kind="counter")
+    tsdb.record("sparknet_rounds_total", "h0", 2.0, T0 + 60.0,
+                kind="counter")
+    slo = SLO.round_time("rt", 0.99, rounds="sparknet_rounds_total",
+                         threshold_s=30.0)
+    # reset semantics make the first sample the baseline: delta is 1
+    assert slo.indicator(tsdb, 300.0, T0 + 60.0) is None
+
+
+def test_round_time_judges_windowed_seconds_per_round():
+    tsdb = TSDB()
+    for i in range(1, 31):  # one round every 10 s
+        tsdb.record("sparknet_rounds_total", "h0", float(i), T0 + 10.0 * i,
+                    kind="counter")
+    slo = SLO.round_time("rt", 0.99, rounds="sparknet_rounds_total",
+                         threshold_s=30.0)
+    bad, total = slo.indicator(tsdb, 300.0, T0 + 300.0)
+    assert bad == 0.0 and total >= 2  # 10 s/round beats 30 s
+    slow = SLO.round_time("rt", 0.99, rounds="sparknet_rounds_total",
+                          threshold_s=5.0)
+    bad, total = slow.indicator(tsdb, 300.0, T0 + 300.0)
+    assert bad == total > 0  # every round in the window is over budget
+
+
+def test_straggler_slo_counts_bad_inside_total():
+    tsdb = TSDB()
+    for i in range(1, 31):
+        tsdb.record("sparknet_rounds_total", "h0", float(10 * i),
+                    T0 + 10.0 * i, kind="counter")
+        tsdb.record("sparknet_straggler_rounds_total", "h0", float(3 * i),
+                    T0 + 10.0 * i, kind="counter")
+    slo = SLO.availability(
+        "straggler-free", 0.9,
+        bad="sparknet_straggler_rounds_total",
+        total="sparknet_rounds_total", bad_outside_total=False,
+    )
+    bad, total = slo.indicator(tsdb, 300.0, T0 + 300.0)
+    # a straggler round IS a round: total must NOT double-count
+    assert math.isclose(bad / total, 0.3)
+    assert math.isclose(total, 290.0)
+
+
+def test_default_slos_cover_the_shipped_series():
+    names = {s.name for s in default_slos()}
+    assert names == {
+        "serve-availability", "serve-ttft-p99", "serve-tpot-p99",
+        "train-round-time", "train-straggler-free",
+    }
+    by_name = {s.name: s for s in default_slos()}
+    assert by_name["serve-availability"].bad_series == (
+        "sparknet_gen_streams_shed_total{"
+    )
+    assert by_name["serve-ttft-p99"].hist == "sparknet_gen_ttft_seconds"
+    assert by_name["train-round-time"].rounds_series == (
+        "sparknet_rounds_total"
+    )
+
+
+def test_unknown_slo_kind_rejected():
+    with pytest.raises(ValueError):
+        SLO("x", "throughput", 0.99)
+
+
+def test_window_label():
+    assert window_label(300.0) == "5m"
+    assert window_label(3600.0) == "1h"
+    assert window_label(21600.0) == "6h"
+    assert window_label(45.0) == "45s"
+
+
+# ---------------------------------------------------------------------------
+# policy fold + alert lifecycle
+
+
+def test_page_requires_short_and_mid_window_and_full_lifecycle(tmp_path):
+    """The whole alert lifecycle on one storm: a fresh burst trips the
+    long-window warn but CANNOT page until the 1 h window also burns
+    at 14.4x; recovery returns to ok.  Each transition must land in
+    the alerts deque, the counter family, the trace stream, and (for
+    the page) the flight-recorder bundle."""
+    tracer = obs.install_tracer(Tracer())
+    bundle = str(tmp_path / "bundle.json")
+    obs_flight.install(obs_flight.FlightRecorder(path=bundle))
+    try:
+        tsdb = TSDB()
+        reg = MetricsRegistry()
+        ev = SLOEvaluator(tsdb, slos=[_avail_slo()], registry=reg,
+                          eval_interval_s=0.0)
+        feed = _ServeFeed(tsdb)
+
+        t = feed.run(T0, 7200, rate=10.0)  # clean history
+        payload = ev.evaluate(now=t)
+        (row,) = payload["slos"]
+        assert row["status"] == "ok" and ev.alerts == type(ev.alerts)(
+            maxlen=256
+        )
+
+        t = feed.run(t, 60, rate=10.0, shed_rate=5.0)  # fresh burst
+        (row,) = ev.evaluate(now=t)["slos"]
+        w = row["windows"]
+        assert w["5m"]["burn"] >= 14.4  # short window is screaming
+        assert w["1h"]["burn"] < 14.4   # ...but the mid window gates
+        assert w["6h"]["burn"] >= 1.0
+        assert row["status"] == "warn"
+        assert row["budget_remaining"] < 1.0
+
+        t = feed.run(t, 600, rate=10.0, shed_rate=5.0)  # sustained
+        (row,) = ev.evaluate(now=t)["slos"]
+        assert row["windows"]["1h"]["burn"] >= 14.4
+        assert row["status"] == "page"
+
+        t = feed.run(t, 21600, rate=10.0)  # full long window clean
+        (row,) = ev.evaluate(now=t)["slos"]
+        assert row["status"] == "ok"
+
+        assert [a["severity"] for a in ev.alerts] == [
+            "warn", "page", "recover"
+        ]
+        assert [(a["from"], a["to"]) for a in ev.alerts] == [
+            ("ok", "warn"), ("warn", "page"), ("page", "ok")
+        ]
+        counters = reg.snapshot()["counters"]
+        for sev in ("warn", "page", "recover"):
+            key = 'sparknet_slo_alerts_total{slo="avail",severity="%s"}' % sev
+            assert counters[key] == 1.0
+        instants = [e for e in tracer.events()
+                    if e.get("ph") == "i" and e["name"] == "slo_alert"]
+        assert [e["args"]["severity"] for e in instants] == [
+            "warn", "page", "recover"
+        ]
+        assert os.path.exists(bundle)  # the page dumped a postmortem
+        with open(bundle) as f:
+            assert json.load(f)["reason"] == "slo_page"
+    finally:
+        obs_flight.uninstall()
+
+
+def test_status_gauges_and_policy_listing():
+    tsdb = TSDB()
+    reg = MetricsRegistry()
+    ev = SLOEvaluator(tsdb, slos=[_avail_slo()], registry=reg)
+    payload = ev.evaluate(now=T0)
+    assert payload["host"] == "fleet"
+    assert payload["policy"] == [
+        {"severity": "page", "burn": 14.4, "windows": ["5m", "1h"]},
+        {"severity": "warn", "burn": 1.0, "windows": ["6h"]},
+    ]
+    snap = reg.snapshot()["gauges"]
+    assert snap['sparknet_slo_status{slo="avail"}'] == -1.0  # no data
+    _ServeFeed(tsdb).run(T0, 600, rate=10.0)
+    ev.evaluate(now=T0 + 600)
+    snap = reg.snapshot()["gauges"]
+    assert snap['sparknet_slo_status{slo="avail"}'] == 0.0
+    assert snap['sparknet_slo_error_budget_remaining{slo="avail"}'] == 1.0
+    assert snap['sparknet_slo_burn_rate{slo="avail",window="5m"}'] == 0.0
+
+
+def test_no_data_transitions_never_alert():
+    """An idle objective flapping no_data<->ok must not page anyone."""
+    tsdb = TSDB()
+    ev = SLOEvaluator(tsdb, slos=[_avail_slo()])
+    ev.evaluate(now=T0)  # no data at all
+    _ServeFeed(tsdb).run(T0, 600, rate=10.0)
+    ev.evaluate(now=T0 + 600)  # clean data -> ok
+    ev.evaluate(now=T0 + 600 + 86400)  # windows empty again -> no_data
+    assert list(ev.alerts) == []
+
+
+def test_state_worst_status_prefers_real_data_over_no_data():
+    """/healthz fold: one healthy objective + one idle objective is
+    "ok" — no_data outranks NOTHING; it only wins when universal."""
+    tsdb = TSDB()
+    ev = SLOEvaluator(
+        tsdb,
+        slos=[
+            _avail_slo(),
+            SLO.latency("ttft", 0.99, hist="sparknet_gen_ttft_seconds",
+                        threshold_s=0.5),
+        ],
+    )
+    assert ev.state()["status"] == "no_data"  # nothing evaluated yet
+    _ServeFeed(tsdb).run(T0, 600, rate=10.0)
+    ev.evaluate(now=T0 + 600)
+    st = ev.state()
+    assert st["slos"] == {"avail": "ok", "ttft": "no_data"}
+    assert st["status"] == "ok"
+    assert st["evaluated_t"] == T0 + 600
+
+
+def test_maybe_evaluate_is_rate_limited():
+    ev = SLOEvaluator(TSDB(), slos=[_avail_slo()], eval_interval_s=15.0)
+    assert ev.maybe_evaluate(now=T0) is not None
+    assert ev.maybe_evaluate(now=T0 + 5) is None
+    assert ev.maybe_evaluate(now=T0 + 20) is not None
+
+
+# ---------------------------------------------------------------------------
+# scaling signals
+
+
+def test_signals_payload_and_gauge_export():
+    tsdb = TSDB()
+    reg = MetricsRegistry()
+    ev = SLOEvaluator(tsdb, registry=reg)
+    feed = _ServeFeed(tsdb, host="h0")
+    for i in range(61):
+        t = T0 + 10.0 * i
+        feed.streams = 90.0 * i
+        feed.shed = 10.0 * i
+        tsdb.record_snapshot(
+            "h0",
+            {
+                "sparknet_gen_streams_total": feed.streams,
+                'sparknet_gen_streams_shed_total{cause="queue_full"}':
+                    feed.shed,
+                "sparknet_rounds_total": float(i),
+            },
+            {"sparknet_feed_queue_depth": 0.5 * 10.0 * i},
+            t,
+        )
+        tsdb.record_snapshot(
+            "h1", {"sparknet_rounds_total": float(2 * i)}, {}, t
+        )
+    sig = ev.signals(now=T0 + 600.0)
+    assert sig["window_s"] == 300.0
+    assert math.isclose(sig["admission_pressure"], 0.1)
+    # the previous window ran at the same shed fraction: flat trend
+    assert math.isclose(sig["admission_pressure_trend"], 0.0, abs_tol=1e-9)
+    assert sig["queue_depth_series"] == "sparknet_feed_queue_depth"
+    assert math.isclose(sig["queue_depth_slope_per_s"], 0.5, rel_tol=0.05)
+    assert math.isclose(sig["round_rate_per_s"]["h0"], 0.1)
+    assert math.isclose(sig["round_rate_per_s"]["h1"], 0.2)
+    assert set(sig["error_budget_remaining"]) == {
+        s.name for s in default_slos()
+    }
+    assert sig["error_budget_min"] == min(
+        sig["error_budget_remaining"].values()
+    )
+    gauges = reg.snapshot()["gauges"]
+    assert gauges["sparknet_signal_admission_pressure"] == (
+        sig["admission_pressure"]
+    )
+    assert gauges['sparknet_signal_round_rate{host="h1"}'] == 0.2
+    assert gauges["sparknet_signal_error_budget_min"] == (
+        sig["error_budget_min"]
+    )
+
+
+def test_signals_live_quantile_rides_the_process_registry():
+    live = MetricsRegistry()
+    h = live.histogram("sparknet_gen_ttft_seconds")
+    for _ in range(50):
+        h.observe(0.3)
+    ev = SLOEvaluator(TSDB(), live_registry=live)
+    sig = ev.signals(now=T0)
+    assert math.isclose(sig["ttft_p99_live_s"], 0.3)
+    assert "ttft_p99_live_s" not in SLOEvaluator(TSDB()).signals(now=T0)
+
+
+# ---------------------------------------------------------------------------
+# sliding-window histogram view (the live p99 the signals read)
+
+
+def test_histogram_window_quantile_reports_the_fresh_regression():
+    """A month of fast requests must not dilute a fresh regression:
+    the TIME-windowed quantile reads only recent observations while
+    the all-history reservoir still remembers the good old days."""
+    h = MetricsRegistry().histogram("lat_seconds")
+    for _ in range(200):
+        h.observe(0.01)  # the long healthy run
+    time.sleep(0.06)
+    for _ in range(20):
+        h.observe(2.0)  # the fresh regression
+    now = time.monotonic()
+    # a wide window still sees everything (read it first: window reads
+    # purge entries older than the window from the timed ring)
+    assert h.window_count(window_s=60.0, now=now) == 220
+    assert h.window_quantile(0.99, window_s=60.0, now=now) == 2.0
+    # a window covering only the regression reports SLOW
+    assert h.window_quantile(0.5, window_s=0.05, now=now) == 2.0
+    assert h.window_count(window_s=0.05, now=now) == 20
+    # the all-time reservoir median is still the healthy era
+    assert h.quantile(0.5) == 0.01
+    # empty window answers 0.0, not an exception
+    assert h.window_quantile(0.5, window_s=0.0, now=now + 100) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# sampler
+
+
+def test_tsdb_sampler_snapshots_registry_and_drives_evaluator():
+    reg = MetricsRegistry()
+    c = reg.counter("sparknet_gen_streams_total")
+    g = reg.gauge("sparknet_gen_active_streams")
+    tsdb = TSDB()
+    ev = SLOEvaluator(tsdb, slos=[_avail_slo()], eval_interval_s=0.0,
+                      host="me")
+    sampler = TsdbSampler(tsdb, reg, evaluator=ev, host="me")
+    c.inc(5)
+    g.set(2)
+    sampler.sample_once(now=T0)
+    c.inc(3)
+    sampler.sample_once(now=T0 + 1)
+    assert tsdb.latest("sparknet_gen_streams_total", host="me") == 8.0
+    assert tsdb.latest("sparknet_gen_active_streams", host="me") == 2.0
+    assert ev._last_eval_t == T0 + 1
+    assert sampler.last_error is None
+
+
+def test_tsdb_sampler_thread_lands_tail_sample_on_stop():
+    reg = MetricsRegistry()
+    c = reg.counter("jobs_total")
+    tsdb = TSDB()
+    sampler = TsdbSampler(tsdb, reg, host="me", interval_s=0.01).start()
+    c.inc(7)
+    time.sleep(0.05)
+    sampler.stop()  # final sample_once lands the tail
+    assert tsdb.latest("jobs_total", host="me") == 7.0
+    assert sampler.last_error is None
+
+
+# ---------------------------------------------------------------------------
+# collector HTTP surface
+
+
+def _get(base, path):
+    try:
+        with urllib.request.urlopen(base + path, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_fleet_collector_serves_query_slo_signals_and_push_age():
+    coll = FleetCollector(host="127.0.0.1", port=0,
+                          slo_eval_interval_s=0.0).start()
+    try:
+        t_now = time.time()
+        for seq in range(10):
+            for hi in range(2):
+                coll.ingest({
+                    "host": "h%d" % hi, "boot_id": "b0", "seq": seq,
+                    "t_send": t_now - (10 - seq) * 2.0, "round": seq,
+                    "counters": {
+                        "sparknet_gen_streams_total": 10.0,
+                        "sparknet_rounds_total": 1.0,
+                    },
+                    "gauges": {"sparknet_gen_active_streams": 2.0 + hi},
+                }, t_recv=t_now - (10 - seq) * 2.0)
+        base = "http://%s:%d" % coll.address
+
+        st, q = _get(base, "/query?series=sparknet_gen_streams_total"
+                           "&range=120&step=1")
+        assert st == 200 and q["host"] == "fleet" and q["points"]
+        assert q["points"][-1]["last"] == 200.0  # both hosts summed
+        assert q["tsdb"]["series"] > 0
+        st, q = _get(base, "/query?series=sparknet_gen_active_streams"
+                           "&host=h1&range=120")
+        assert st == 200 and q["points"][-1]["last"] == 3.0
+
+        st, body = _get(base, "/query")
+        assert st == 400 and "error" in body
+        st, body = _get(base, "/query?series=nope&range=60")
+        assert st == 404 and "error" in body
+        assert body["series_available"] > 0
+
+        st, s = _get(base, "/slo")
+        assert st == 200 and {"slos", "policy", "alerts"} <= set(s)
+        assert {r["name"] for r in s["slos"]} == {
+            x.name for x in default_slos()
+        }
+
+        st, g = _get(base, "/signals")
+        assert st == 200
+        assert {"admission_pressure", "queue_depth_slope_per_s",
+                "round_rate_per_s", "error_budget_min"} <= set(g)
+
+        st, hz = _get(base, "/healthz")
+        assert st == 200 and hz["slo"]["status"] in (
+            "ok", "warn", "page", "no_data"
+        )
+
+        st, fv = _get(base, "/fleet")
+        assert st == 200
+        for h in ("h0", "h1"):
+            age = fv["hosts"][h]["last_push_age_s"]
+            assert isinstance(age, float) and age >= 0.0
+    finally:
+        coll.close()
+
+
+# ---------------------------------------------------------------------------
+# single-host exporter surface (obs.start --slo)
+
+
+def test_obs_start_slo_arms_sampler_evaluator_and_endpoints():
+    run = obs.start(slo=True, port=0, echo=lambda *_: None)
+    try:
+        assert run.sampler is not None and run.exporter is not None
+        # two deterministic samples: the first snapshot is taken before
+        # the store refreshes its own gauges, so only the second one
+        # carries a non-zero sparknet_tsdb_series reading
+        run.sampler.sample_once()
+        run.sampler.sample_once()
+        base = "http://%s:%d" % run.exporter.address
+
+        st, q = _get(base, "/query?series=sparknet_tsdb_series&range=60")
+        assert st == 200 and q["points"]
+        assert q["points"][-1]["last"] >= 1.0
+
+        st, s = _get(base, "/slo")
+        assert st == 200 and {"slos", "policy", "alerts"} <= set(s)
+
+        st, g = _get(base, "/signals")
+        assert st == 200 and "error_budget_min" in g
+
+        st, hz = _get(base, "/healthz")
+        assert st == 200 and "slo" in hz
+        assert obs.slo_state() is not None
+    finally:
+        run.close()
+    assert obs.slo_state() is None  # close cleared the /healthz hook
+
+
+def test_exporter_without_tsdb_keeps_404_contract():
+    reg = MetricsRegistry()
+    ex = ObsExporter(reg, port=0).start()
+    try:
+        base = "http://%s:%d" % ex.address
+        for path in ("/query?series=x", "/slo", "/signals"):
+            try:
+                with urllib.request.urlopen(base + path, timeout=10) as r:
+                    status = r.status
+            except urllib.error.HTTPError as e:
+                status = e.code
+            assert status == 404
+    finally:
+        ex.close()
+
+
+# ---------------------------------------------------------------------------
+# exporter thread-safety: scrapes racing registry writes
+
+
+def test_exporter_concurrent_scrapes_while_registry_grows():
+    """Scrape /metrics continuously while another thread registers new
+    label families and observes histograms: every response must be a
+    complete, parseable exposition — no torn lines, no 500s."""
+    reg = MetricsRegistry()
+    ex = ObsExporter(reg, port=0).start()
+    errors = []
+    stop = threading.Event()
+
+    def scraper():
+        base = "http://%s:%d/metrics" % ex.address
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(base, timeout=10) as r:
+                    if r.status != 200:
+                        errors.append("status %d" % r.status)
+                        return
+                    text = r.read().decode()
+                for line in text.splitlines():
+                    if not line or line.startswith("#"):
+                        continue
+                    name, value = line.rsplit(" ", 1)
+                    float(value)  # torn writes would fail to parse
+                    if not name:
+                        errors.append("empty sample name")
+            except Exception as e:  # noqa: BLE001 — collected, asserted
+                errors.append(repr(e))
+                return
+
+    threads = [threading.Thread(target=scraper) for _ in range(3)]
+    for th in threads:
+        th.start()
+    try:
+        for i in range(25):  # grow the registry under the scrapers
+            fam = reg.counter("load%d_total" % i, "hammer",
+                              labels=("kind",))
+            for j in range(4):
+                fam.labels(str(j)).inc(j + 1)
+            h = reg.histogram("lat%d_seconds" % i, "hammer")
+            for j in range(8):
+                h.observe(0.001 * (j + 1))
+            time.sleep(0.002)
+    finally:
+        stop.set()
+        for th in threads:
+            th.join(10.0)
+        ex.close()
+    assert errors == []
+    # the final scrape-equivalent render holds every family
+    text = reg.render()
+    assert "load24_total" in text and "lat24_seconds_bucket" in text
+
+
+# ---------------------------------------------------------------------------
+# offline report (tools/slo_report.py) — same evaluator as /slo
+
+
+def test_slo_report_replays_runlog_through_the_live_evaluator(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "slo_report", os.path.join(_REPO, "tools", "slo_report.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    log = tmp_path / "run.trace.jsonl"
+    recs = []
+    t = T0
+    for i in range(1200):  # 20 min of serve traffic, 1 req/s
+        t = T0 + float(i)
+        recs.append({"kind": "span", "name": "request", "cat": "req",
+                     "ts_s": t, "dur_ms": 50.0})
+        recs.append({"kind": "span", "name": "prefill", "cat": "gen",
+                     "ts_s": t, "dur_ms": 120.0})
+        if 600 <= i < 900:  # a 5-minute shed storm
+            recs.append({"kind": "instant", "name": "shed",
+                         "t_s": t + 0.001,
+                         "args": {"cause": "queue_full"}})
+        if i % 30 == 0:
+            recs.append({"kind": "span", "name": "average",
+                         "cat": "phase", "ts_s": t, "dur_ms": 900.0,
+                         "host": "trainer"})
+    with open(log, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
+    events = mod.load_events(str(log))
+    rep = mod.replay(events, eval_interval_s=15.0)
+    assert rep["events_folded"] > 0
+    assert set(rep["hosts"]) == {"local", "trainer"}
+    storm = [a for a in rep["alerts"]
+             if a["slo"] == "serve-availability"]
+    assert storm and storm[0]["severity"] in ("warn", "page")
+    assert {"slos", "policy", "alerts"} <= set(rep["slo"])
+    assert rep["signals"]["admission_pressure"] >= 0.0
+    assert rep["tsdb"]["series"] > 0
+    # the rendered report is printable text containing the timeline
+    text = mod.render(rep)
+    assert "alert timeline" in text and "serve-availability" in text
+    # CLI smoke: --json round-trips
+    rc = mod.main([str(log), "--json"])
+    assert rc == 0

@@ -1,0 +1,272 @@
+"""The in-process time-series store (``obs/tsdb.py``): ring/rollup
+bucket semantics, counter-rate derivation with reset handling, stage
+selection, the byte budget, windowed folds, and the registry-exported
+self-accounting."""
+
+import math
+
+from sparknet_tpu.obs.metrics import MetricsRegistry
+from sparknet_tpu.obs.tsdb import (
+    DEFAULT_STAGES,
+    SERIES_OVERHEAD_BYTES,
+    Series,
+    TSDB,
+    bucket_quantile,
+)
+
+T0 = 1_000_000.0
+
+
+def _fill_counter(t, name="c_total", host="h0", n=120, start=T0, inc=2.0):
+    for i in range(n):
+        t.record(name, host, inc * (i + 1), start + i, kind="counter")
+
+
+# ---------------------------------------------------------------------------
+# bucket/ring semantics
+
+
+def test_raw_buckets_carry_min_max_mean_count_last():
+    t = TSDB()
+    for v in (5.0, 1.0, 3.0):
+        t.record("g", "h0", v, T0 + 0.2, kind="gauge")
+    q = t.query("g", host="h0", range_s=10, now=T0 + 1)
+    (p,) = q["points"]
+    assert p["min"] == 1.0 and p["max"] == 5.0
+    assert p["count"] == 3 and p["last"] == 3.0
+    assert math.isclose(p["mean"], 3.0)
+    assert p["rate"] is None  # gauges have no rate
+
+
+def test_ring_advance_clears_skipped_buckets():
+    t = TSDB(stages=((1.0, 8),))
+    t.record("g", "h0", 1.0, T0, kind="gauge")
+    # jump 5 buckets forward: the skipped ones must read empty, not
+    # leak the old lap's data
+    t.record("g", "h0", 2.0, T0 + 5, kind="gauge")
+    q = t.query("g", host="h0", range_s=8, now=T0 + 5)
+    assert [p["last"] for p in q["points"]] == [1.0, 2.0]
+    # a whole-lap jump keeps only the newest bucket
+    t.record("g", "h0", 9.0, T0 + 100, kind="gauge")
+    q = t.query("g", host="h0", range_s=8, now=T0 + 100)
+    assert [p["last"] for p in q["points"]] == [9.0]
+
+
+def test_too_old_sample_is_dropped_not_wrapped():
+    t = TSDB(stages=((1.0, 4),))
+    t.record("g", "h0", 1.0, T0 + 10, kind="gauge")
+    t.record("g", "h0", 99.0, T0, kind="gauge")  # older than retention
+    q = t.query("g", host="h0", range_s=20, now=T0 + 10)
+    assert [p["last"] for p in q["points"]] == [1.0]
+
+
+def test_all_stages_record_the_same_samples():
+    t = TSDB()
+    _fill_counter(t, n=121)
+    # from_t = T0+20 aligns with the 10 s stage, so both stages cover
+    # the exact same samples
+    raw = t.query("c_total", host="h0", range_s=100, step_s=1, now=T0 + 120)
+    roll = t.query("c_total", host="h0", range_s=100, step_s=10,
+                   now=T0 + 120)
+    assert raw["step_s"] == 1.0 and roll["step_s"] == 10.0
+    assert sum(p["count"] for p in raw["points"]) == sum(
+        p["count"] for p in roll["points"]
+    )
+    # rollup mins/maxes are folds of exactly the raw samples
+    assert min(p["min"] for p in raw["points"]) == min(
+        p["min"] for p in roll["points"]
+    )
+    assert max(p["max"] for p in raw["points"]) == max(
+        p["max"] for p in roll["points"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# counter rate + resets
+
+
+def test_counter_rate_from_consecutive_lasts():
+    t = TSDB()
+    _fill_counter(t, n=60, inc=3.0)  # +3/s
+    q = t.query("c_total", host="h0", range_s=30, now=T0 + 59)
+    rates = [p["rate"] for p in q["points"] if p["rate"] is not None]
+    assert rates and all(math.isclose(r, 3.0) for r in rates)
+
+
+def test_counter_reset_never_uncounts():
+    t = TSDB()
+    for i, v in enumerate((10.0, 20.0, 30.0, 5.0, 8.0)):
+        t.record("c_total", "h0", v, T0 + i, kind="counter")
+    inc, span = t.window_delta("c_total", 10.0, T0 + 4)
+    # 10->30 = +20, reset to 5 counts the post-reset value, then +3
+    assert math.isclose(inc, 20.0 + 5.0 + 3.0)
+    assert span == 4.0
+
+
+def test_window_delta_prefix_folds_label_family():
+    t = TSDB()
+    for i in range(10):
+        t.record('shed_total{cause="a"}', "h0", float(i), T0 + i,
+                 kind="counter")
+        t.record('shed_total{cause="b"}', "h0", 2.0 * i, T0 + i,
+                 kind="counter")
+    inc, _ = t.window_delta_prefix("shed_total{", 20.0, T0 + 9)
+    assert math.isclose(inc, 9.0 + 18.0)
+
+
+# ---------------------------------------------------------------------------
+# stage selection
+
+
+def test_query_picks_finest_stage_covering_range():
+    t = TSDB()
+    _fill_counter(t, n=10)
+    assert t.query("c_total", range_s=60, now=T0 + 9)["step_s"] == 1.0
+    # raw retention is 300 s: a 1000 s range must fall to the 10 s stage
+    assert t.query("c_total", range_s=1000, now=T0 + 9)["step_s"] == 10.0
+    # and a 6 h range to the 60 s stage
+    assert t.query("c_total", range_s=21600, now=T0 + 9)["step_s"] == 60.0
+    # an explicit step is a floor, never refined below
+    assert t.query(
+        "c_total", range_s=60, step_s=10, now=T0 + 9
+    )["step_s"] == 10.0
+
+
+def test_query_unknown_series_returns_none():
+    assert TSDB().query("nope") is None
+
+
+# ---------------------------------------------------------------------------
+# fleet aggregation
+
+
+def test_fleet_query_pools_hosts():
+    t = TSDB()
+    _fill_counter(t, host="h0", n=30, inc=1.0)
+    _fill_counter(t, host="h1", n=30, inc=2.0)
+    q = t.query("c_total", range_s=10, now=T0 + 29)
+    assert q["host"] == "fleet"
+    p = q["points"][-1]
+    assert p["count"] == 2  # one sample per host in the bucket
+    assert math.isclose(p["last"], 30.0 + 60.0)  # summed totals
+    assert math.isclose(p["rate"], 3.0)  # rates add
+    inc, _ = t.window_delta("c_total", 10.0, T0 + 29)
+    inc0, _ = t.window_delta("c_total", 10.0, T0 + 29, host="h0")
+    assert math.isclose(inc, 3 * inc0)
+
+
+def test_latest_and_hosts_and_series_names():
+    t = TSDB()
+    _fill_counter(t, host="h0", n=5, inc=1.0)
+    _fill_counter(t, host="h1", n=5, inc=10.0)
+    assert t.hosts() == ["h0", "h1"]
+    assert t.series_names("c_") == ["c_total"]
+    assert t.latest("c_total", host="h1") == 50.0
+    assert t.latest("c_total") == 55.0
+    assert t.latest("missing") is None
+
+
+# ---------------------------------------------------------------------------
+# budget accounting
+
+
+def test_budget_refuses_new_series_but_keeps_existing_recording():
+    one_series = Series("gauge", DEFAULT_STAGES).nbytes
+    t = TSDB(budget_bytes=one_series + SERIES_OVERHEAD_BYTES)
+    assert t.record("a", "h0", 1.0, T0) is True
+    assert t.record("b", "h0", 1.0, T0) is False  # refused at budget
+    assert t.record("a", "h0", 2.0, T0 + 1) is True  # existing still ok
+    st = t.stats()
+    assert st["series"] == 1 and st["dropped_series_total"] == 1
+    assert st["resident_bytes"] <= st["budget_bytes"]
+    assert t.query("b") is None
+
+
+def test_stats_shape_and_registry_export():
+    reg = MetricsRegistry()
+    t = TSDB(registry=reg)
+    t.record_snapshot("h0", {"c_total": 5.0}, {"g": 1.0}, T0)
+    t.record_snapshot("h0", {"c_total": 6.0}, {"g": 2.0}, T0 + 1)
+    st = t.stats()
+    assert st["samples_total"] == 4 and st["series"] == 2
+    assert [s["step_s"] for s in st["stages"]] == [1.0, 10.0, 60.0]
+    snap = reg.snapshot()
+    assert snap["gauges"]["sparknet_tsdb_series"] == 2.0
+    assert snap["gauges"]["sparknet_tsdb_resident_bytes"] == float(
+        st["resident_bytes"]
+    )
+    assert snap["counters"]["sparknet_tsdb_samples_total"] == 4.0
+
+
+def test_tsdb_reuses_existing_registry_families():
+    reg = MetricsRegistry()
+    a = TSDB(registry=reg)
+    b = TSDB(registry=reg)  # must not raise on duplicate registration
+    a.record("x", "h0", 1.0, T0)
+    b.record("y", "h0", 1.0, T0)
+    a.refresh_metrics()
+    b.refresh_metrics()
+    assert reg.snapshot()["gauges"]["sparknet_tsdb_series"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# windowed folds for the evaluator
+
+
+def test_window_stats_for_gauges():
+    t = TSDB()
+    for i in range(20):
+        t.record("depth", "h0", float(i % 5), T0 + i, kind="gauge")
+    ws = t.window_stats("depth", 20.0, T0 + 19)
+    assert ws["min"] == 0.0 and ws["max"] == 4.0
+    assert ws["last"] == 4.0
+    assert math.isclose(ws["mean"], sum(i % 5 for i in range(20)) / 20.0)
+
+
+def test_slope_per_s_signs():
+    t = TSDB()
+    for i in range(30):
+        t.record("up", "h0", 2.0 * i, T0 + i, kind="gauge")
+        t.record("down", "h0", 100.0 - i, T0 + i, kind="gauge")
+        t.record("flat", "h0", 7.0, T0 + i, kind="gauge")
+    assert math.isclose(t.slope_per_s("up", 30.0, T0 + 29), 2.0)
+    assert math.isclose(t.slope_per_s("down", 30.0, T0 + 29), -1.0)
+    assert t.slope_per_s("flat", 30.0, T0 + 29) == 0.0
+    assert t.slope_per_s("missing", 30.0, T0 + 29) == 0.0
+
+
+def test_histogram_window_and_quantile():
+    t = TSDB()
+    # ship cumulative bucket counters the way a registry snapshot does:
+    # 80 obs <= 0.1, 18 more <= 0.5, 2 in the +Inf tail
+    for i in range(1, 11):
+        t.record('h_bucket{le="0.1"}', "h0", 8.0 * i, T0 + i,
+                 kind="counter")
+        t.record('h_bucket{le="0.5"}', "h0", 9.8 * i, T0 + i,
+                 kind="counter")
+        t.record('h_bucket{le="+Inf"}', "h0", 10.0 * i, T0 + i,
+                 kind="counter")
+        t.record("h_sum", "h0", 1.5 * i, T0 + i, kind="counter")
+        t.record("h_count", "h0", 10.0 * i, T0 + i, kind="counter")
+    hw = t.histogram_window("h", 60.0, T0 + 10)
+    # the first sample is the baseline (a brand-new counter's initial
+    # value has no measured interval), so increases run i=1 -> i=10
+    assert hw["count"] == 90.0
+    les = dict(hw["le"])
+    assert math.isclose(les[0.1], 72.0)
+    assert math.isclose(les[0.5], 88.2)
+    assert les[float("inf")] == 90.0
+    p50 = bucket_quantile(hw["le"], 0.5)
+    assert 0.0 < p50 <= 0.1
+    p95 = bucket_quantile(hw["le"], 0.95)
+    assert 0.1 < p95 <= 0.5
+    # the +Inf bucket answers its lower finite bound
+    assert bucket_quantile(hw["le"], 0.999) == 0.5
+    assert bucket_quantile([], 0.5) == 0.0
+
+
+def test_histogram_window_none_when_no_movement():
+    t = TSDB()
+    t.record("h_count", "h0", 5.0, T0, kind="counter")
+    t.record("h_count", "h0", 5.0, T0 + 1, kind="counter")
+    assert t.histogram_window("h", 10.0, T0 + 1) is None

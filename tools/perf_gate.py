@@ -374,6 +374,31 @@ RULES: Dict[str, List[Rule]] = {
         Rule("slow_replica_correct", "==", 1),
         Rule("replica_skew", ">=", 1.5),
     ],
+    "SLO": [
+        # the time-series + burn-rate alerting contract (bench.py
+        # --mode=slo, obs/tsdb.py + obs/slo.py): each seeded fault's
+        # FIRST alert lands within one 300 s burn window of its seed
+        # (value = worst delay / window), the healthy control replay
+        # fires ZERO alerts across real evaluations, the ring+rollup
+        # store holds the full 3-host series set under its byte budget
+        # without dropping series, the 10 s rollups agree with raw
+        # step-1 queries, /signals matches recomputation from raw
+        # series, and the collector's HTTP surface answers end to end.
+        # Threshold-vs-measured-latency sanity lives in _cross_rules
+        # vs SERVEOBS; signal trustworthiness vs FLEET.
+        Rule("value", "<", 1.0),
+        Rule("latency_alert_fired", "is", True),
+        Rule("shed_alert_fired", "is", True),
+        Rule("latency_detect_delay_s", "<", 300.0),
+        Rule("shed_detect_delay_s", "<", 300.0),
+        Rule("control_false_alarms", "==", 0),
+        Rule("control_evals", ">", 0),
+        Rule("tsdb_under_budget", "is", True),
+        Rule("tsdb_dropped_series", "==", 0),
+        Rule("downsample_agree", "is", True),
+        Rule("signals_match", "is", True),
+        Rule("endpoints_ok", "is", True),
+    ],
 }
 
 
@@ -645,6 +670,41 @@ def _cross_rules(arts: Dict[str, dict]) -> List[Tuple[str, bool, str]]:
                  and ttps >= 0.25 * tps),
             "traced_tokens_per_s=%r >= 0.25 x genserve "
             "continuous_tokens_per_s=%r" % (ttps, tps),
+        ))
+    slo = arts.get("SLO")
+    if slo is not None and sobs is not None:
+        # the latency objective must be ACHIEVABLE on this box: the
+        # 0.5 s TTFT threshold has to clear the serveobs artifact's
+        # independently measured p95 — an objective the hardware
+        # cannot meet would page forever and the control-leg silence
+        # above would be vacuous
+        thr = slo.get("ttft_threshold_ms")
+        p95 = sobs.get("ttft_p95_ms")
+        out.append((
+            "SLO x SERVEOBS",
+            bool(thr is not None and p95 is not None and thr >= p95),
+            "slo ttft_threshold_ms=%r >= serveobs measured "
+            "ttft_p95_ms=%r" % (thr, p95),
+        ))
+    fleet = arts.get("FLEET")
+    if slo is not None and fleet is not None:
+        # /signals is only as trustworthy as the fleet plane under it:
+        # the collector must have proven dead-host detection and
+        # bounded clock offset, and the signal API must cover every
+        # simulated host's round rate
+        out.append((
+            "SLO x FLEET",
+            bool(
+                fleet.get("dead_detected") is True
+                and fleet.get("clock_offset_bounded") is True
+                and slo.get("round_rate_hosts") == slo.get("hosts")
+            ),
+            "fleet dead_detected=%r, clock_offset_bounded=%r, slo "
+            "round_rate_hosts=%r == hosts=%r" % (
+                fleet.get("dead_detected"),
+                fleet.get("clock_offset_bounded"),
+                slo.get("round_rate_hosts"), slo.get("hosts"),
+            ),
         ))
     comm = arts.get("COMM")
     if kern is not None and comm is not None:

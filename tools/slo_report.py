@@ -1,0 +1,286 @@
+"""Fold a run log (or fleet bundle) into an offline SLO/burn-rate report.
+
+Input: a structured run-log ``.jsonl`` written by ``--trace_out`` /
+``obs.Tracer`` — or the fleet collector's merged ``/runlog`` bundle
+(``obs/fleet.py``), whose records carry a ``host`` tag.  The folding
+reconstructs the canonical metric families from the events the log
+already holds:
+
+- cat ``req`` ``request`` spans     -> ``sparknet_gen_streams_total``
+- ``shed`` instants (cause arg)     -> ``sparknet_gen_streams_shed_total``
+- cat ``gen`` ``prefill`` spans     -> ``sparknet_gen_ttft_seconds``
+  (prefill duration is the offline TTFT proxy: submit-to-first-token
+  minus queueing, the dominant component)
+- cat ``gen`` ``decode_step`` spans -> ``sparknet_gen_intertoken_seconds``
+- cat ``phase`` ``average`` spans   -> ``sparknet_rounds_total``
+- ``profile`` instants (straggler)  -> ``sparknet_straggler_rounds_total``
+
+There is ONE evaluation implementation: the reconstructed counters are
+played into a real ``obs.tsdb.TSDB`` at a 1 s cadence and judged by a
+real ``obs.slo.SLOEvaluator`` at the live evaluator's own cadence —
+the exact code behind the collector's ``/slo`` endpoint.  The offline
+verdicts CANNOT drift from the live ones, because they are the same
+code.
+
+    python tools/slo_report.py RUN.trace.jsonl
+    python tools/slo_report.py bundle.runlog.jsonl --eval-interval 15
+    python tools/slo_report.py RUN.trace.jsonl --json   # machine form
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from sparknet_tpu.obs.metrics import MetricsRegistry  # noqa: E402
+from sparknet_tpu.obs.slo import SLOEvaluator  # noqa: E402
+from sparknet_tpu.obs.tsdb import TSDB  # noqa: E402
+
+
+def load_events(path: str) -> List[tuple]:
+    """Parse a run-log ``.jsonl`` (or Chrome trace ``.json``) into
+    ``(t_s, host, kind, name, cat, dur_s, args)`` tuples sorted by
+    time.  Span tuples are stamped at span END (the moment the live
+    counter would have moved)."""
+    events: List[tuple] = []
+
+    def _take(name, cat, kind, t0_s, dur_s, args, host):
+        host = host or "local"
+        if kind == "span":
+            events.append((t0_s + dur_s, host, kind, name, cat,
+                           dur_s, args or {}))
+        elif kind == "instant":
+            events.append((t0_s, host, kind, name, cat, 0.0, args or {}))
+
+    if path.endswith(".jsonl"):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                _take(
+                    rec.get("name"), rec.get("cat"), rec.get("kind"),
+                    float(rec.get("ts_s", rec.get("t_s", 0.0))),
+                    float(rec.get("dur_ms", 0.0)) / 1e3,
+                    rec.get("args"), rec.get("host"),
+                )
+    else:
+        with open(path) as f:
+            doc = json.load(f)
+        for ev in (doc["traceEvents"] if isinstance(doc, dict) else doc):
+            args = ev.get("args") or {}
+            _take(
+                ev.get("name"), ev.get("cat"),
+                {"X": "span", "i": "instant"}.get(ev.get("ph")),
+                float(ev.get("ts", 0.0)) / 1e6,
+                float(ev.get("dur", 0.0)) / 1e6,
+                args, ev.get("host") or args.get("host"),
+            )
+    events.sort(key=lambda e: e[0])
+    return events
+
+
+class _HostCounters:
+    """One host's reconstructed canonical families (live Metric
+    objects, so bucket layout and sample names match the shipped
+    registry exactly)."""
+
+    def __init__(self):
+        self.registry = MetricsRegistry()
+        r = self.registry
+        self.streams = r.counter(
+            "sparknet_gen_streams_total", "reconstructed from request spans"
+        )
+        self.shed = r.counter(
+            "sparknet_gen_streams_shed_total",
+            "reconstructed from shed instants", labels=("cause",),
+        )
+        self.ttft = r.histogram(
+            "sparknet_gen_ttft_seconds",
+            "reconstructed from prefill spans",
+        )
+        self.intertoken = r.histogram(
+            "sparknet_gen_intertoken_seconds",
+            "reconstructed from decode_step spans",
+        )
+        self.rounds = r.counter(
+            "sparknet_rounds_total", "reconstructed from average spans"
+        )
+        self.stragglers = r.counter(
+            "sparknet_straggler_rounds_total",
+            "reconstructed from profile instants",
+        )
+
+    def fold(self, kind, name, cat, dur_s, args) -> bool:
+        if kind == "span":
+            if name == "request" and cat == "req":
+                self.streams.inc()
+            elif name == "prefill" and cat == "gen":
+                self.ttft.observe(dur_s)
+            elif name == "decode_step" and cat == "gen":
+                self.intertoken.observe(dur_s)
+            elif name == "average" and cat == "phase":
+                self.rounds.inc()
+            else:
+                return False
+            return True
+        if name == "shed":
+            self.shed.labels(args.get("cause", "unknown")).inc()
+            return True
+        if name == "profile":
+            if args.get("straggler"):
+                self.stragglers.inc()
+            return True
+        return False
+
+
+def replay(events: List[tuple], eval_interval_s: float = 15.0,
+           push_interval_s: float = 1.0) -> dict:
+    """Play the log through a real TSDB + SLOEvaluator and return the
+    full report: alert timeline, final /slo payload, final /signals."""
+    tsdb = TSDB()
+    ev = SLOEvaluator(tsdb, eval_interval_s=eval_interval_s)
+    hosts = {}
+    folded = 0
+    t_first = events[0][0]
+    next_push = t_first + push_interval_s
+
+    def _push(now):
+        for h, hc in hosts.items():
+            snap = hc.registry.snapshot()
+            tsdb.record_snapshot(h, snap["counters"], snap["gauges"], now)
+        ev.maybe_evaluate(now)
+
+    for t, host, kind, name, cat, dur_s, args in events:
+        while t >= next_push:
+            _push(next_push)
+            next_push += push_interval_s
+        hc = hosts.get(host)
+        if hc is None:
+            hc = hosts[host] = _HostCounters()
+        if hc.fold(kind, name, cat, dur_s, args):
+            folded += 1
+    t_last = events[-1][0]
+    _push(t_last)
+    final = ev.evaluate(now=t_last)
+    return {
+        "events_folded": folded,
+        "hosts": sorted(hosts),
+        "span_s": round(t_last - t_first, 3),
+        "alerts": list(ev.alerts),
+        "slo": final,
+        "signals": ev.signals(now=t_last),
+        "tsdb": tsdb.stats(),
+    }
+
+
+def render(rep: dict) -> str:
+    t0 = min(
+        (a["t"] for a in rep["alerts"]),
+        default=rep["slo"]["t"] - rep["span_s"],
+    )
+    lines = [
+        "slo: folded %d event(s) over %.1f s from %d host(s): %s"
+        % (rep["events_folded"], rep["span_s"], len(rep["hosts"]),
+           ", ".join(rep["hosts"])),
+        "",
+        "alert timeline (%d transition(s)):" % len(rep["alerts"]),
+    ]
+    if not rep["alerts"]:
+        lines.append("  (none — every objective inside budget)")
+    for a in rep["alerts"]:
+        burns = "  ".join(
+            f"{w}={b:.2f}x" for w, b in sorted(a["burn"].items())
+            if b is not None
+        )
+        lines.append(
+            "  +%8.1fs  %-24s %-8s (%s -> %s)  burn %s"
+            % (a["t"] - t0, a["slo"], a["severity"].upper(),
+               a["from"], a["to"], burns)
+        )
+    lines.append("")
+    lines.append(
+        f"{'objective':>24} {'status':>8} {'budget left':>12}  burn by window"
+    )
+    for row in rep["slo"]["slos"]:
+        burns = "  ".join(
+            "%s=%.2fx" % (w, v["burn"]) if v["burn"] is not None
+            else f"{w}=—"
+            for w, v in sorted(row["windows"].items())
+        )
+        lines.append(
+            "%24s %8s %12.4f  %s"
+            % (row["name"], row["status"], row["budget_remaining"], burns)
+        )
+    sig = rep["signals"]
+    lines.append("")
+    lines.append("scaling signals (final window):")
+    lines.append(
+        "  admission pressure %.4f (trend %+.4f)   queue slope %+.4f/s"
+        % (sig["admission_pressure"], sig["admission_pressure_trend"],
+           sig["queue_depth_slope_per_s"])
+    )
+    if sig.get("ttft_p99_s") is not None:
+        lines.append(
+            "  ttft p99 %.3fs (trend %+.4f)"
+            % (sig["ttft_p99_s"], sig["ttft_p99_trend"])
+        )
+    for h, r in sorted(sig["round_rate_per_s"].items()):
+        lines.append("  round rate %s: %.3f/s" % (h, r))
+    lines.append(
+        "  error budget min %.4f" % sig["error_budget_min"]
+    )
+    st = rep["tsdb"]
+    lines.append(
+        "tsdb: %d series, %d samples, %.1f KiB resident (budget %.1f MiB)"
+        % (st["series"], st["samples_total"],
+           st["resident_bytes"] / 1024, st["budget_bytes"] / (1 << 20))
+    )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="offline SLO burn-rate report from a run log or "
+        "fleet bundle (same evaluator as the live /slo endpoint)"
+    )
+    ap.add_argument("path", help=".jsonl run log / bundle or .trace.json")
+    ap.add_argument("--eval-interval", type=float, default=15.0,
+                    help="evaluator cadence in log seconds (default 15)")
+    ap.add_argument("--push-interval", type=float, default=1.0,
+                    help="TSDB snapshot cadence in log seconds (default 1)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable JSON instead of the report")
+    args = ap.parse_args(argv)
+
+    events = load_events(args.path)
+    if not events:
+        print("no events found in %s — was tracing on?" % args.path,
+              file=sys.stderr)
+        return 1
+    rep = replay(events, eval_interval_s=args.eval_interval,
+                 push_interval_s=args.push_interval)
+    if not rep["events_folded"]:
+        print(
+            "no SLO-relevant events found (need request/prefill/"
+            "decode_step/average spans or shed/profile instants)",
+            file=sys.stderr,
+        )
+        return 1
+    if args.json:
+        print(json.dumps(rep, indent=2, sort_keys=True))
+    else:
+        print(render(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
